@@ -1,0 +1,32 @@
+"""Test harness config.
+
+Per SURVEY.md §4.3 the reference's distributed tests run "multi-node without a
+cluster" (CPU Gloo DDP).  The TPU-native analog: run every test on XLA:CPU
+with a virtual 8-device mesh so pjit/shard_map paths execute real collectives
+without TPU hardware.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("TPU_AIR_NUM_CHIPS", "8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+import tpu_air  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def air():
+    """Session-scoped runtime — mirrors the notebooks' single ray.init()."""
+    tpu_air.init(num_cpus=4, num_chips=8)
+    yield tpu_air
+    tpu_air.shutdown()

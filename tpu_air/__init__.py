@@ -1,0 +1,86 @@
+"""tpu_air — a TPU-native distributed ML framework.
+
+Provides the capability surface of the `ray-project/anyscale-workshop-nyc-2023`
+reference stack (Ray Core / Data / Train / Tune / AIR predictors / Serve — see
+SURVEY.md), re-designed TPU-first: JAX/XLA SPMD over device meshes for compute,
+XLA collectives over ICI/DCN instead of NCCL, chip/sub-mesh leases instead of
+GPU scheduling, and a shared-memory host object store for the data plane.
+
+Top-level API mirrors the names the reference workloads call::
+
+    import tpu_air
+
+    tpu_air.init()
+    ref = tpu_air.put(big_array)
+
+    @tpu_air.remote
+    def f(x): ...
+    results = tpu_air.get([f.remote(ref) for _ in range(8)])
+    tpu_air.shutdown()
+
+Subsystem layers live in submodules, imported lazily to keep worker startup
+light: ``tpu_air.data``, ``tpu_air.train``, ``tpu_air.tune``,
+``tpu_air.predict``, ``tpu_air.serve``, ``tpu_air.parallel``,
+``tpu_air.models``.
+"""
+
+from tpu_air._version import __version__
+from tpu_air.core import (
+    ActorDiedError,
+    ActorHandle,
+    ActorPool,
+    ObjectRef,
+    RemoteError,
+    TpuAirError,
+    get,
+    init,
+    is_initialized,
+    kill,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+
+_LAZY_SUBMODULES = (
+    "data",
+    "train",
+    "tune",
+    "predict",
+    "serve",
+    "parallel",
+    "models",
+    "ops",
+    "job",
+    "utils",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        module = importlib.import_module(f"tpu_air.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'tpu_air' has no attribute '{name}'")
+
+
+__all__ = [
+    "ActorDiedError",
+    "ActorHandle",
+    "ActorPool",
+    "ObjectRef",
+    "RemoteError",
+    "TpuAirError",
+    "__version__",
+    "get",
+    "init",
+    "is_initialized",
+    "kill",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+    *_LAZY_SUBMODULES,
+]

@@ -1,0 +1,146 @@
+"""Host-side shared-memory object store.
+
+TPU-native replacement for the reference stack's plasma store (SURVEY.md §2B:
+"per-node shared-memory store; zero-copy Arrow objects").  Objects are
+immutable (Overview_of_Ray.ipynb:cc-4 "Objects. In-memory, immutable"), keyed
+by ``ObjectRef``, and shared between the driver and worker processes on the
+same host through files in ``/dev/shm`` (tmpfs == shared memory): a writer
+serializes with out-of-band buffers (serialization.py), writes to a temp file
+and atomically renames to seal; readers ``mmap`` the sealed file and
+reconstruct numpy/Arrow payloads zero-copy over the mapping.
+
+A C++ arena-based store (``tpu_air/_native``) provides an accelerated backend
+with the same wire format when built; this module is the always-available
+fallback and the reference semantics.
+
+Cross-host fetch (DCN) goes through the control plane in ``runtime.py`` —
+single-host deployments (everything the reference exercises locally) never hit
+it.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import secrets
+import time
+from typing import Any, Optional
+
+from . import serialization
+
+
+class ObjectRef:
+    """Handle to an immutable object in the store.
+
+    Mirrors the semantics of the reference's ``ray._raylet.ObjectRef`` (leaks
+    into user code at Scaling_batch_inference.ipynb:cc-127): hashable, cheap to
+    copy between processes, resolvable with ``tpu_air.get``.
+    """
+
+    __slots__ = ("id",)
+
+    def __init__(self, id: str):
+        self.id = id
+
+    def hex(self) -> str:
+        return self.id
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.id})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __hash__(self) -> int:
+        return hash(self.id)
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id,))
+
+
+def new_object_id() -> str:
+    return secrets.token_hex(16)
+
+
+class ObjectStore:
+    """File-per-object store rooted in shared memory.
+
+    The store directory is created by the head process and shared (by path)
+    with every worker; any process may put or get.  Sealing is atomic
+    (``os.rename``), so a reader either sees a complete object or none.
+    """
+
+    def __init__(self, root: str, create: bool = False):
+        self.root = root
+        if create:
+            os.makedirs(root, exist_ok=True)
+
+    # -- paths ------------------------------------------------------------
+    def _path(self, object_id: str) -> str:
+        return os.path.join(self.root, object_id)
+
+    # -- write ------------------------------------------------------------
+    def put(self, value: Any, object_id: Optional[str] = None) -> ObjectRef:
+        object_id = object_id or new_object_id()
+        self.put_serialized(serialization.serialize(value), object_id)
+        return ObjectRef(object_id)
+
+    def put_serialized(self, chunks, object_id: str) -> None:
+        tmp = self._path(f".tmp-{object_id}-{os.getpid()}")
+        with open(tmp, "wb") as f:
+            for c in chunks:
+                f.write(c)
+        os.chmod(tmp, 0o444)  # immutability contract
+        os.rename(tmp, self._path(object_id))
+
+    # -- read -------------------------------------------------------------
+    def contains(self, object_id: str) -> bool:
+        return os.path.exists(self._path(object_id))
+
+    def wait_for(self, object_id: str, timeout: Optional[float] = None) -> bool:
+        """Block until the object is sealed. Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.0005
+        while not self.contains(object_id):
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(delay)
+            delay = min(delay * 2, 0.01)
+        return True
+
+    def get(self, object_id: str, timeout: Optional[float] = None) -> Any:
+        if not self.wait_for(object_id, timeout):
+            raise TimeoutError(f"object {object_id} not available after {timeout}s")
+        path = self._path(object_id)
+        size = os.path.getsize(path)
+        if size == 0:
+            return serialization.loads(serialization.dumps(None))
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            m = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        # Zero-copy lifetime: out-of-band buffers come back as memoryview
+        # slices of the mmap; any numpy array built over them holds a
+        # reference chain (ndarray → memoryview → mmap), so the mapping stays
+        # valid exactly as long as the value references it.
+        return serialization.deserialize(m, zero_copy=True)
+
+    def delete(self, object_id: str) -> None:
+        try:
+            os.chmod(self._path(object_id), 0o644)
+            os.remove(self._path(object_id))
+        except FileNotFoundError:
+            pass
+
+    def destroy(self) -> None:
+        try:
+            for name in os.listdir(self.root):
+                try:
+                    os.chmod(os.path.join(self.root, name), 0o644)
+                    os.remove(os.path.join(self.root, name))
+                except OSError:
+                    pass
+            os.rmdir(self.root)
+        except OSError:
+            pass

@@ -1,0 +1,727 @@
+"""tpu_air core runtime: tasks, actors, objects over host processes.
+
+This is the TPU-native counterpart of the reference stack's Ray Core layer
+(raylet + GCS + core_worker, SURVEY.md §1-L1/§2B), collapsed for a single-host
+control domain into one driver-side scheduler plus a pool of persistent worker
+processes:
+
+* **tasks** — stateless remote functions (``@tpu_air.remote`` on a function,
+  Overview_of_Ray.ipynb:cc-41), executed on any idle worker with enough
+  resources;
+* **actors** — stateful remote classes (Scaling_batch_inference.ipynb:cc-105),
+  each pinned to a dedicated worker process, method calls executed FIFO;
+* **objects** — immutable values in the shared-memory store
+  (object_store.py); every task/actor result is sealed there and resolved by
+  ``get``/``wait`` exactly like ``ray.get``/``ray.wait``
+  (Overview_of_Ray.ipynb:cc-44, Scaling_batch_inference.ipynb:cc-115).
+
+Scheduling resources are **CPUs and TPU chips** (not GPUs): an actor asking
+for ``num_chips=k`` receives a lease of k physical chip ids, exported to its
+process as ``TPU_AIR_CHIP_IDS`` so the parallel layer can build the matching
+sub-mesh (SURVEY.md §2B raylet row: "placement = sub-mesh assignment").
+
+Workers may themselves submit tasks / create actors (nested ``.remote``):
+control messages ride the worker⇄driver pipe up to the scheduler, and results
+always come back through the object store, so there is a single data plane.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import secrets
+import sys
+import tempfile
+import threading
+import time
+import traceback
+import multiprocessing as mp
+import multiprocessing.connection as mpc
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .object_store import ObjectRef, ObjectStore, new_object_id
+
+# --------------------------------------------------------------------------
+# errors
+# --------------------------------------------------------------------------
+
+
+class TpuAirError(Exception):
+    pass
+
+
+class RemoteError(TpuAirError):
+    """A task/actor method raised; carries the remote traceback."""
+
+    def __init__(self, cause_repr: str, remote_traceback: str):
+        super().__init__(f"{cause_repr}\n\n--- remote traceback ---\n{remote_traceback}")
+        self.cause_repr = cause_repr
+        self.remote_traceback = remote_traceback
+
+
+class ActorDiedError(TpuAirError):
+    pass
+
+
+class _ErrorSentinel:
+    """Stored in the object store in place of a result when a task fails."""
+
+    def __init__(self, cause_repr: str, tb: str):
+        self.cause_repr = cause_repr
+        self.tb = tb
+
+    def raise_(self):
+        raise RemoteError(self.cause_repr, self.tb)
+
+
+def _resolve_if_error(value):
+    if isinstance(value, _ErrorSentinel):
+        value.raise_()
+    return value
+
+
+# --------------------------------------------------------------------------
+# specs / messages
+# --------------------------------------------------------------------------
+
+_INLINE_LIMIT = 512 * 1024  # payloads larger than this travel via the store
+
+
+@dataclass
+class _TaskSpec:
+    task_id: str            # also the result object id
+    payload: Optional[bytes]  # cloudpickle of (fn, args, kwargs); None if via store
+    payload_ref: Optional[str]
+    resources: Dict[str, float]
+    kind: str = "task"      # "task" | "actor_create" | "actor_task"
+    actor_id: Optional[str] = None
+    method: Optional[str] = None
+    from_worker: bool = False
+
+
+@dataclass
+class _WorkerState:
+    worker_id: int
+    proc: mp.process.BaseProcess
+    conn: mpc.Connection
+    busy_task: Optional[str] = None
+    actor_id: Optional[str] = None   # set => dedicated actor worker
+    alive: bool = True
+
+
+@dataclass
+class _ActorState:
+    actor_id: str
+    worker: _WorkerState
+    name: Optional[str]
+    chip_ids: List[int] = field(default_factory=list)
+    resources: Dict[str, float] = field(default_factory=dict)
+    dead: bool = False
+    pending: int = 0
+
+
+# --------------------------------------------------------------------------
+# worker process
+# --------------------------------------------------------------------------
+
+_worker_ctx: Optional["_WorkerContext"] = None
+
+
+class _WorkerContext:
+    """Per-worker client handle back to the driver scheduler."""
+
+    def __init__(self, conn: mpc.Connection, store: ObjectStore, worker_id: int):
+        self.conn = conn
+        self.store = store
+        self.worker_id = worker_id
+        self.send_lock = threading.Lock()
+
+    def send(self, msg):
+        with self.send_lock:
+            self.conn.send(msg)
+
+
+def current_worker() -> Optional["_WorkerContext"]:
+    return _worker_ctx
+
+
+def _store_result(store: ObjectStore, object_id: str, fn, args, kwargs):
+    try:
+        result = fn(*args, **kwargs)
+        store.put(result, object_id)
+        return True
+    except BaseException as e:  # noqa: BLE001 - remote boundary
+        store.put(_ErrorSentinel(repr(e), traceback.format_exc()), object_id)
+        return False
+
+
+def _load_payload(store: ObjectStore, spec: dict):
+    blob = spec["payload"]
+    if blob is None:
+        blob = store.get(spec["payload_ref"])
+    return serialization.loads(blob)
+
+
+def _resolve_args(store: ObjectStore, args, kwargs):
+    def r(v):
+        return store.get(v.id) if isinstance(v, ObjectRef) else v
+
+    args = [r(a) for a in args]
+    kwargs = {k: r(v) for k, v in kwargs.items()}
+    for v in itertools.chain(args, kwargs.values()):
+        _resolve_if_error(v)
+    return args, kwargs
+
+
+def _worker_main(worker_id: int, store_root: str, conn: mpc.Connection):
+    global _worker_ctx
+    store = ObjectStore(store_root)
+    _worker_ctx = _WorkerContext(conn, store, worker_id)
+    actors: Dict[str, Any] = {}
+    failed_actors: Dict[str, _ErrorSentinel] = {}
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        kind = msg[0]
+        if kind == "shutdown":
+            return
+        spec = msg[1]
+        if kind == "task":
+            fn, args, kwargs = _load_payload(store, spec)
+            try:
+                args, kwargs = _resolve_args(store, args, kwargs)
+            except RemoteError as e:
+                store.put(_ErrorSentinel(repr(e), e.remote_traceback), spec["task_id"])
+                _worker_ctx.send(("done", worker_id, spec["task_id"]))
+                continue
+            _store_result(store, spec["task_id"], fn, args, kwargs)
+            _worker_ctx.send(("done", worker_id, spec["task_id"]))
+        elif kind == "actor_create":
+            chip_ids = spec.get("chip_ids") or []
+            if chip_ids:
+                # Export the chip lease so the parallel layer (mesh.py) builds
+                # this actor's sub-mesh from exactly these devices.
+                os.environ["TPU_AIR_CHIP_IDS"] = ",".join(str(c) for c in chip_ids)
+            cls, args, kwargs = _load_payload(store, spec)
+            args, kwargs = _resolve_args(store, args, kwargs)
+            _store_result(store, spec["task_id"], cls, args, kwargs)
+            # fetch back so a failed __init__ is visible to callers
+            inst = store.get(spec["task_id"])
+            if isinstance(inst, _ErrorSentinel):
+                failed_actors[spec["actor_id"]] = inst
+            else:
+                actors[spec["actor_id"]] = inst
+            _worker_ctx.send(("done", worker_id, spec["task_id"]))
+        elif kind == "actor_task":
+            inst = actors.get(spec["actor_id"])
+            _, args, kwargs = _load_payload(store, spec)
+            if inst is None:
+                init_err = failed_actors.get(spec["actor_id"])
+                store.put(
+                    init_err
+                    if init_err is not None
+                    else _ErrorSentinel("ActorDiedError('actor failed to initialize')", ""),
+                    spec["task_id"],
+                )
+            else:
+                try:
+                    args, kwargs = _resolve_args(store, args, kwargs)
+                    method = getattr(inst, spec["method"])
+                except RemoteError as e:
+                    store.put(_ErrorSentinel(repr(e), e.remote_traceback), spec["task_id"])
+                    _worker_ctx.send(("done", worker_id, spec["task_id"]))
+                    continue
+                _store_result(store, spec["task_id"], method, args, kwargs)
+            _worker_ctx.send(("done", worker_id, spec["task_id"]))
+
+
+# --------------------------------------------------------------------------
+# driver-side runtime
+# --------------------------------------------------------------------------
+
+_STALE_SESSION_AGE_S = 2 * 3600.0
+
+
+def _sweep_stale_sessions(base: str) -> None:
+    """Remove store dirs leaked by killed sessions (tmpfs is RAM — leaks
+    accumulate).  A dir is stale when untouched for _STALE_SESSION_AGE_S."""
+    now = time.time()
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith("tpu_air-"):
+            continue
+        path = os.path.join(base, name)
+        try:
+            if now - os.path.getmtime(path) < _STALE_SESSION_AGE_S:
+                continue
+            for f in os.listdir(path):
+                try:
+                    os.chmod(os.path.join(path, f), 0o644)
+                    os.remove(os.path.join(path, f))
+                except OSError:
+                    pass
+            os.rmdir(path)
+        except OSError:
+            pass
+
+
+class Runtime:
+    """Driver-side scheduler + control plane (the GCS/raylet analog)."""
+
+    def __init__(
+        self,
+        num_cpus: Optional[int] = None,
+        num_chips: Optional[int] = None,
+        start_method: Optional[str] = None,
+        store_root: Optional[str] = None,
+    ):
+        self.session_id = secrets.token_hex(8)
+        base = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+        _sweep_stale_sessions(base)
+        self.store_root = store_root or os.path.join(base, f"tpu_air-{self.session_id}")
+        self.store = ObjectStore(self.store_root, create=True)
+        self.num_cpus = num_cpus if num_cpus is not None else max(2, os.cpu_count() or 2)
+        if num_chips is None:
+            num_chips = int(os.environ.get("TPU_AIR_NUM_CHIPS", "0") or 0)
+        self.num_chips = num_chips
+        self.free_chips: List[int] = list(range(self.num_chips))
+        self.avail = {"cpu": float(self.num_cpus), "chip": float(self.num_chips)}
+        method = start_method or os.environ.get("TPU_AIR_START_METHOD", "fork")
+        self.mp_ctx = mp.get_context(method)
+        self.lock = threading.RLock()
+        self.workers: Dict[int, _WorkerState] = {}
+        self.actors: Dict[str, _ActorState] = {}
+        self.named_actors: Dict[str, str] = {}
+        self.task_resources: Dict[str, Dict[str, float]] = {}
+        self.task_worker: Dict[str, int] = {}
+        self.queue: List[_TaskSpec] = []
+        self._next_worker_id = itertools.count()
+        self._stop = threading.Event()
+        self._wakeup_r, self._wakeup_w = mp.Pipe(duplex=False)
+        self._listener = threading.Thread(target=self._listen, daemon=True)
+        self._listener.start()
+        self._min_idle = min(2, self.num_cpus)
+        for _ in range(self._min_idle):
+            self._spawn_worker()
+
+    # -- worker management -------------------------------------------------
+    def _spawn_worker(self, actor_id: Optional[str] = None) -> _WorkerState:
+        wid = next(self._next_worker_id)
+        parent, child = mp.Pipe(duplex=True)
+        proc = self.mp_ctx.Process(
+            target=_worker_main,
+            args=(wid, self.store_root, child),
+            daemon=True,
+            name=f"tpu_air-worker-{wid}",
+        )
+        proc.start()
+        child.close()
+        ws = _WorkerState(worker_id=wid, proc=proc, conn=parent, actor_id=actor_id)
+        with self.lock:
+            self.workers[wid] = ws
+        self._poke_listener()
+        return ws
+
+    def _poke_listener(self):
+        try:
+            self._wakeup_w.send(b"x")
+        except OSError:
+            pass
+
+    # -- listener thread ----------------------------------------------------
+    def _listen(self):
+        while not self._stop.is_set():
+            with self.lock:
+                conns = [w.conn for w in self.workers.values() if w.alive]
+                conn_owner = {id(w.conn): w for w in self.workers.values() if w.alive}
+            ready = mpc.wait(conns + [self._wakeup_r], timeout=0.2)
+            for conn in ready:
+                if conn is self._wakeup_r:
+                    try:
+                        self._wakeup_r.recv()
+                    except (EOFError, OSError):
+                        pass
+                    continue
+                owner = conn_owner.get(id(conn))
+                if owner is None:
+                    continue
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    self._on_worker_death(owner)
+                    continue
+                try:
+                    self._handle_msg(owner, msg)
+                except Exception:  # noqa: BLE001 - listener must survive
+                    traceback.print_exc(file=sys.stderr)
+
+    def _handle_msg(self, worker: _WorkerState, msg):
+        kind = msg[0]
+        if kind == "done":
+            _, wid, task_id = msg
+            with self.lock:
+                res = self.task_resources.pop(task_id, None)
+                self.task_worker.pop(task_id, None)
+                if res:
+                    self._release(res)
+                if worker.busy_task == task_id:
+                    worker.busy_task = None
+                st = self.actors.get(worker.actor_id) if worker.actor_id else None
+                if st:
+                    st.pending = max(0, st.pending - 1)
+            self._schedule()
+        elif kind == "submit":
+            spec = _TaskSpec(**msg[1])
+            spec.from_worker = True
+            self._enqueue(spec)
+        elif kind == "create_actor":
+            kw = msg[1]
+            # May block waiting for resources — never block the listener.
+            threading.Thread(
+                target=self._create_actor, kwargs={**kw, "from_worker": True}, daemon=True
+            ).start()
+        elif kind == "actor_call":
+            spec = _TaskSpec(**msg[1])
+            spec.from_worker = True
+            self._submit_actor_task_spec(spec)
+        elif kind == "kill_actor":
+            self.kill_actor(msg[1], no_restart=True)
+
+    def _on_worker_death(self, worker: _WorkerState):
+        with self.lock:
+            worker.alive = False
+            outstanding = [
+                t for t, wid in self.task_worker.items() if wid == worker.worker_id
+            ]
+            for task_id in outstanding:
+                self.task_worker.pop(task_id, None)
+                res = self.task_resources.pop(task_id, None)
+                if res:
+                    self._release(res)
+                if not self.store.contains(task_id):
+                    self.store.put(
+                        _ErrorSentinel(
+                            f"WorkerCrashed(worker={worker.worker_id})",
+                            "worker process died while executing this task",
+                        ),
+                        task_id,
+                    )
+            if worker.actor_id and worker.actor_id in self.actors:
+                st = self.actors[worker.actor_id]
+                st.dead = True
+                self.free_chips.extend(st.chip_ids)
+                self.avail["chip"] += len(st.chip_ids)
+                st.chip_ids = []
+            self.workers.pop(worker.worker_id, None)
+        self._schedule()
+
+    # -- resources ----------------------------------------------------------
+    def _can_fit(self, res: Dict[str, float]) -> bool:
+        return all(self.avail.get(k, 0.0) >= v for k, v in res.items())
+
+    def _acquire(self, res: Dict[str, float]):
+        for k, v in res.items():
+            self.avail[k] = self.avail.get(k, 0.0) - v
+
+    def _release(self, res: Dict[str, float]):
+        for k, v in res.items():
+            self.avail[k] = self.avail.get(k, 0.0) + v
+
+    def _check_satisfiable(self, res: Dict[str, float]):
+        total = {"cpu": float(self.num_cpus), "chip": float(self.num_chips)}
+        for k, v in res.items():
+            if v > total.get(k, 0.0):
+                raise TpuAirError(
+                    f"resource request {res} exceeds cluster total {total}"
+                )
+
+    # -- task submission -----------------------------------------------------
+    def _pack_payload(self, payload_tuple) -> Tuple[Optional[bytes], Optional[str]]:
+        blob = serialization.dumps(payload_tuple)
+        if len(blob) <= _INLINE_LIMIT:
+            return blob, None
+        ref = self.store.put(blob)
+        return None, ref.id
+
+    def submit_task(self, fn, args, kwargs, resources: Dict[str, float]) -> ObjectRef:
+        self._check_satisfiable(resources)
+        task_id = new_object_id()
+        payload, payload_ref = self._pack_payload((fn, args, kwargs))
+        spec = _TaskSpec(task_id, payload, payload_ref, resources)
+        self._enqueue(spec)
+        return ObjectRef(task_id)
+
+    def _enqueue(self, spec: _TaskSpec):
+        with self.lock:
+            self.queue.append(spec)
+        self._schedule()
+
+    def _schedule(self):
+        spawn_needed = 0
+        with self.lock:
+            remaining: List[_TaskSpec] = []
+            idle = [
+                w
+                for w in self.workers.values()
+                if w.alive and w.busy_task is None and w.actor_id is None
+            ]
+            for spec in self.queue:
+                if not idle or not self._can_fit(spec.resources):
+                    remaining.append(spec)
+                    continue
+                worker = idle.pop()
+                self._acquire(spec.resources)
+                self.task_resources[spec.task_id] = spec.resources
+                self.task_worker[spec.task_id] = worker.worker_id
+                worker.busy_task = spec.task_id
+                worker.conn.send(
+                    (
+                        "task",
+                        {
+                            "task_id": spec.task_id,
+                            "payload": spec.payload,
+                            "payload_ref": spec.payload_ref,
+                        },
+                    )
+                )
+            self.queue = remaining
+            # Deadlock avoidance: a worker blocked on a nested task's result
+            # occupies its process slot, so nested submissions get fresh
+            # workers when the pool is saturated.
+            stuck = [s for s in remaining if s.from_worker and self._can_fit(s.resources)]
+            if stuck and not idle:
+                spawn_needed = min(len(stuck), 4)
+        for _ in range(spawn_needed):
+            self._spawn_worker()
+
+    # -- actors --------------------------------------------------------------
+    def create_actor(
+        self,
+        cls,
+        args,
+        kwargs,
+        resources: Dict[str, float],
+        name: Optional[str] = None,
+    ) -> Tuple[str, ObjectRef]:
+        actor_id = new_object_id()
+        ready_id = new_object_id()
+        payload, payload_ref = self._pack_payload((cls, args, kwargs))
+        self._create_actor(
+            actor_id=actor_id,
+            ready_id=ready_id,
+            payload=payload,
+            payload_ref=payload_ref,
+            resources=resources,
+            name=name,
+        )
+        return actor_id, ObjectRef(ready_id)
+
+    def _create_actor(
+        self,
+        actor_id: str,
+        ready_id: str,
+        payload,
+        payload_ref,
+        resources: Dict[str, float],
+        name: Optional[str],
+        from_worker: bool = False,
+    ):
+        self._check_satisfiable(resources)
+        # Actors hold their resources for their whole lifetime; block until
+        # available (chip leases especially — SURVEY.md §7 hard-part 1).
+        deadline = time.monotonic() + 120.0
+        while True:
+            with self.lock:
+                if self._can_fit(resources):
+                    self._acquire(resources)
+                    nchips = int(resources.get("chip", 0))
+                    chip_ids = [self.free_chips.pop(0) for _ in range(nchips)]
+                    break
+            if time.monotonic() > deadline:
+                raise TpuAirError(f"timed out waiting for actor resources {resources}")
+            time.sleep(0.01)
+        worker = self._spawn_worker(actor_id=actor_id)
+        st = _ActorState(actor_id, worker, name, chip_ids, resources)
+        with self.lock:
+            self.actors[actor_id] = st
+            if name:
+                self.named_actors[name] = actor_id
+            worker.busy_task = ready_id
+            st.pending += 1
+            self.task_resources[ready_id] = {}
+            self.task_worker[ready_id] = worker.worker_id
+            worker.conn.send(
+                (
+                    "actor_create",
+                    {
+                        "task_id": ready_id,
+                        "payload": payload,
+                        "payload_ref": payload_ref,
+                        "actor_id": actor_id,
+                        "chip_ids": chip_ids,
+                    },
+                )
+            )
+
+    def submit_actor_task(self, actor_id, method, args, kwargs) -> ObjectRef:
+        task_id = new_object_id()
+        payload, payload_ref = self._pack_payload((None, args, kwargs))
+        spec = _TaskSpec(
+            task_id, payload, payload_ref, {}, kind="actor_task",
+            actor_id=actor_id, method=method,
+        )
+        self._submit_actor_task_spec(spec)
+        return ObjectRef(task_id)
+
+    def _submit_actor_task_spec(self, spec: _TaskSpec):
+        with self.lock:
+            st = self.actors.get(spec.actor_id)
+            if st is None or st.dead or not st.worker.alive:
+                self.store.put(
+                    _ErrorSentinel(f"ActorDiedError(actor={spec.actor_id})", ""),
+                    spec.task_id,
+                )
+                return
+            st.pending += 1
+            self.task_resources[spec.task_id] = {}
+            self.task_worker[spec.task_id] = st.worker.worker_id
+            st.worker.conn.send(
+                (
+                    "actor_task",
+                    {
+                        "task_id": spec.task_id,
+                        "payload": spec.payload,
+                        "payload_ref": spec.payload_ref,
+                        "actor_id": spec.actor_id,
+                        "method": spec.method,
+                    },
+                )
+            )
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        with self.lock:
+            st = self.actors.get(actor_id)
+            if st is None:
+                return
+            st.dead = True
+            if st.name:
+                self.named_actors.pop(st.name, None)
+            self._release(st.resources)
+            self.free_chips.extend(st.chip_ids)
+            st.chip_ids = []
+            worker = st.worker
+            worker.alive = False
+            self.workers.pop(worker.worker_id, None)
+        try:
+            worker.conn.send(("shutdown",))
+        except OSError:
+            pass
+        worker.proc.join(timeout=2)
+        if worker.proc.is_alive():
+            worker.proc.terminate()
+
+    # -- object plane ---------------------------------------------------------
+    def put(self, value) -> ObjectRef:
+        return self.store.put(value)
+
+    def get(self, ref, timeout: Optional[float] = None):
+        if isinstance(ref, list):
+            return [self.get(r, timeout) for r in ref]
+        if not isinstance(ref, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(ref)}")
+        return _resolve_if_error(self.store.get(ref.id, timeout=timeout))
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        if not isinstance(refs, list):
+            raise TypeError("wait() expects a list of ObjectRefs")
+        if num_returns > len(refs):
+            raise ValueError("num_returns may not exceed len(refs)")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        delay = 0.0005
+        while len(ready) < num_returns:
+            still = []
+            for r in pending:
+                if self.store.contains(r.id):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(delay)
+            delay = min(delay * 2, 0.005)
+        return ready, pending
+
+    # -- lifecycle -------------------------------------------------------------
+    def shutdown(self):
+        self._stop.set()
+        self._poke_listener()
+        self._listener.join(timeout=2)
+        with self.lock:
+            workers = list(self.workers.values())
+            self.workers.clear()
+            self.actors.clear()
+        for w in workers:
+            try:
+                w.conn.send(("shutdown",))
+            except OSError:
+                pass
+        for w in workers:
+            w.proc.join(timeout=1)
+            if w.proc.is_alive():
+                w.proc.terminate()
+        self.store.destroy()
+
+
+# --------------------------------------------------------------------------
+# module-level singleton API
+# --------------------------------------------------------------------------
+
+_runtime: Optional[Runtime] = None
+
+
+def init(
+    num_cpus: Optional[int] = None,
+    num_chips: Optional[int] = None,
+    ignore_reinit_error: bool = True,
+    **kwargs,
+) -> Runtime:
+    """Start the tpu_air runtime (the ``ray.init()`` analog,
+    Install_locally.md:58-64). Idempotent by default."""
+    global _runtime
+    if _runtime is not None:
+        if ignore_reinit_error:
+            return _runtime
+        raise TpuAirError("tpu_air.init() called twice")
+    _runtime = Runtime(num_cpus=num_cpus, num_chips=num_chips, **kwargs)
+    return _runtime
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def shutdown():
+    global _runtime
+    if _runtime is not None:
+        _runtime.shutdown()
+        _runtime = None
+
+
+def get_runtime() -> Runtime:
+    """Return the active runtime, auto-initializing like Ray does on first
+    ``.remote()`` call."""
+    if _runtime is None:
+        init()
+    return _runtime
