@@ -1,0 +1,47 @@
+"""tpu_air.data — distributed datasets over shared-memory blocks (L2)."""
+
+from . import preprocessors
+from .dataset import ActorPoolStrategy, Dataset, GroupedData
+from .io import (
+    from_arrow,
+    from_huggingface,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+from .preprocessors import (
+    BatchMapper,
+    Chain,
+    MinMaxScaler,
+    Normalizer,
+    PowerTransformer,
+    Preprocessor,
+    StandardScaler,
+)
+
+__all__ = [
+    "ActorPoolStrategy",
+    "BatchMapper",
+    "Chain",
+    "Dataset",
+    "GroupedData",
+    "MinMaxScaler",
+    "Normalizer",
+    "PowerTransformer",
+    "Preprocessor",
+    "StandardScaler",
+    "from_arrow",
+    "from_huggingface",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "preprocessors",
+    "range",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+]

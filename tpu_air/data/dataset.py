@@ -1,0 +1,445 @@
+"""Distributed Dataset: lists of blocks in the object store.
+
+Parity surface (SURVEY.md §1-L2, exercised at the cited cells):
+``map_batches`` (Scaling_model_training.ipynb:cc-33), ``limit``
+(Model_finetuning…ipynb:cc-21), ``train_test_split`` (Introduction…ipynb:cc-10),
+``repartition`` (cc-11), ``schema/count/show/take/to_pandas`` (cc-15-17),
+``groupby(...).mean(...)`` (cc-18), ``drop_columns`` (cc-58), plus ``split``
+(per-worker shards feeding the Trainer, Model_finetuning…ipynb:cc-29 figure).
+
+Blocks live in the shared-memory object store (core layer) and are processed
+in parallel by tasks or an actor pool — preprocessing stays on host CPUs;
+device work enters only at the trainer/predictor boundary (SURVEY.md §7
+architecture stance).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from tpu_air.core import ObjectRef, get, put, remote
+from tpu_air.core.actor_pool import ActorPool
+
+from . import block as B
+
+
+class ActorPoolStrategy:
+    """compute= strategy for map_batches: a fixed/autoscaling pool of actors
+    (the architecture behind BatchPredictor, Scaling_batch_inference.ipynb:cc-4
+    "autoscaling the actor pool")."""
+
+    def __init__(self, size: Optional[int] = None, min_size: int = 1,
+                 max_size: Optional[int] = None, num_chips: float = 0):
+        self.size = size
+        self.min_size = size or min_size
+        self.max_size = size or max_size or max(2, self.min_size)
+        self.num_chips = num_chips
+
+
+def _apply_fn_to_block(fn, blk, batch_size, batch_format, fn_args, fn_kwargs):
+    n = B.block_num_rows(blk)
+    if n == 0:
+        return blk
+    step = batch_size or n
+    outs = []
+    for start in range(0, n, step):
+        batch = B.to_batch_format(B.block_slice(blk, start, min(start + step, n)), batch_format)
+        out = fn(batch, *fn_args, **fn_kwargs)
+        outs.append(B.from_batch(out))
+    return B.concat_blocks(outs)
+
+
+@remote
+def _map_block(fn, blk, batch_size, batch_format, fn_args, fn_kwargs):
+    return _apply_fn_to_block(fn, blk, batch_size, batch_format, fn_args, fn_kwargs)
+
+
+@remote
+class _MapWorker:
+    """Actor for callable-class map_batches (holds expensive state, e.g. a
+    model on a leased chip)."""
+
+    def __init__(self, fn_or_cls, constructor_args, constructor_kwargs):
+        if isinstance(fn_or_cls, type):
+            self.fn = fn_or_cls(*constructor_args, **constructor_kwargs)
+        else:
+            self.fn = fn_or_cls
+
+    def apply(self, blk, batch_size, batch_format, fn_args, fn_kwargs):
+        return _apply_fn_to_block(self.fn, blk, batch_size, batch_format, fn_args, fn_kwargs)
+
+
+class Dataset:
+    """A distributed dataset = ordered list of block refs."""
+
+    def __init__(self, block_refs: List[ObjectRef]):
+        self._block_refs = list(block_refs)
+        self._cached_num_rows: Optional[int] = None
+
+    # -- introspection -----------------------------------------------------
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def get_internal_block_refs(self) -> List[ObjectRef]:
+        return list(self._block_refs)
+
+    def _blocks(self) -> Iterator[B.Block]:
+        for ref in self._block_refs:
+            yield get(ref)
+
+    def count(self) -> int:
+        if self._cached_num_rows is None:
+            self._cached_num_rows = sum(B.block_num_rows(b) for b in self._blocks())
+        return self._cached_num_rows
+
+    def __len__(self) -> int:  # convenience; Ray deprecates this but HF uses len()
+        return self.count()
+
+    def schema(self):
+        for b in self._blocks():
+            if B.block_num_rows(b) > 0:
+                return B.block_schema(b)
+        return None
+
+    def columns(self) -> List[str]:
+        for b in self._blocks():
+            return B.block_columns(b)
+        return []
+
+    def stats(self) -> str:
+        return (
+            f"Dataset(num_blocks={self.num_blocks()}, num_rows={self.count()}, "
+            f"columns={self.columns()})"
+        )
+
+    def __repr__(self) -> str:
+        return self.stats()
+
+    # -- materialization ---------------------------------------------------
+    def to_pandas(self, limit: Optional[int] = None) -> pd.DataFrame:
+        dfs = []
+        seen = 0
+        for b in self._blocks():
+            df = B.block_to_pandas(b)
+            dfs.append(df)
+            seen += len(df)
+            if limit is not None and seen >= limit:
+                break
+        if not dfs:
+            return pd.DataFrame()
+        out = pd.concat(dfs, ignore_index=True)
+        return out.iloc[:limit] if limit is not None else out
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        rows: List[Dict[str, Any]] = []
+        for b in self._blocks():
+            df = B.block_to_pandas(b)
+            for _, row in df.iterrows():
+                rows.append(row.to_dict())
+                if len(rows) >= n:
+                    return rows
+        return rows
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return self.take(self.count())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for b in self._blocks():
+            df = B.block_to_pandas(b)
+            for _, row in df.iterrows():
+                yield row.to_dict()
+
+    def iter_batches(
+        self,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "pandas",
+        drop_last: bool = False,
+    ):
+        """Sequential batch iterator (feeds host→device transfer in the
+        trainer; batches are exact-size across block boundaries)."""
+        carry: Optional[B.Block] = None
+        for b in self._blocks():
+            cur = b if carry is None else B.concat_blocks([carry, b])
+            carry = None
+            n = B.block_num_rows(cur)
+            if batch_size is None:
+                yield B.to_batch_format(cur, batch_format)
+                continue
+            start = 0
+            while n - start >= batch_size:
+                yield B.to_batch_format(
+                    B.block_slice(cur, start, start + batch_size), batch_format
+                )
+                start += batch_size
+            if start < n:
+                carry = B.block_slice(cur, start, n)
+        if carry is not None and not drop_last:
+            yield B.to_batch_format(carry, batch_format)
+
+    # -- transforms --------------------------------------------------------
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = 4096,
+        batch_format: str = "pandas",
+        compute: Optional[Union[str, ActorPoolStrategy]] = None,
+        fn_args: Tuple = (),
+        fn_kwargs: Optional[Dict[str, Any]] = None,
+        fn_constructor_args: Tuple = (),
+        fn_constructor_kwargs: Optional[Dict[str, Any]] = None,
+        num_chips: float = 0,
+        **ray_remote_args,
+    ) -> "Dataset":
+        """Apply ``fn`` to batches of each block, in parallel.
+
+        * default compute: one task per block;
+        * ``compute=ActorPoolStrategy(size=k)`` (or a callable class ``fn``):
+          a pool of k actors, each constructing ``fn`` once — the predictor
+          path (§3.3).
+        """
+        fn_kwargs = fn_kwargs or {}
+        fn_constructor_kwargs = fn_constructor_kwargs or {}
+        use_actors = isinstance(compute, ActorPoolStrategy) or isinstance(fn, type)
+        if not use_actors:
+            task = _map_block
+            if num_chips or ray_remote_args:
+                task = task.options(num_chips=num_chips or None, **ray_remote_args)
+            refs = [
+                task.remote(fn, ref, batch_size, batch_format, fn_args, fn_kwargs)
+                for ref in self._block_refs
+            ]
+            return Dataset(refs)
+
+        strategy = compute if isinstance(compute, ActorPoolStrategy) else ActorPoolStrategy()
+        pool_size = strategy.size or min(max(strategy.min_size, 1),
+                                         max(len(self._block_refs), 1), strategy.max_size)
+        chips = num_chips or strategy.num_chips
+        worker_cls = _MapWorker.options(num_chips=chips or None, **ray_remote_args)
+        actors = [
+            worker_cls.remote(fn, fn_constructor_args, fn_constructor_kwargs)
+            for _ in range(pool_size)
+        ]
+        pool = ActorPool(actors)
+        out_refs: List[ObjectRef] = []
+        pending: List[ObjectRef] = list(self._block_refs)
+        try:
+            # ordered map over blocks, recycling idle actors
+            idx = 0
+            while idx < len(pending) and pool.has_free():
+                pool.submit(
+                    lambda a, v: a.apply.remote(v, batch_size, batch_format, fn_args, fn_kwargs),
+                    pending[idx],
+                )
+                idx += 1
+            for _ in range(len(pending)):
+                out_refs.append(put(pool.get_next()))
+                if idx < len(pending):
+                    pool.submit(
+                        lambda a, v: a.apply.remote(v, batch_size, batch_format, fn_args, fn_kwargs),
+                        pending[idx],
+                    )
+                    idx += 1
+        finally:
+            from tpu_air.core import kill
+
+            for a in actors:
+                kill(a)
+        return Dataset(out_refs)
+
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
+        def batch_fn(df: pd.DataFrame) -> pd.DataFrame:
+            return pd.DataFrame([fn(r.to_dict()) for _, r in df.iterrows()])
+
+        return self.map_batches(batch_fn, batch_size=None, batch_format="pandas")
+
+    def filter(self, fn: Callable[[Dict[str, Any]], bool]) -> "Dataset":
+        def batch_fn(df: pd.DataFrame) -> pd.DataFrame:
+            mask = [bool(fn(r.to_dict())) for _, r in df.iterrows()]
+            return df[np.asarray(mask, dtype=bool)]
+
+        return self.map_batches(batch_fn, batch_size=None, batch_format="pandas")
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda df: df.drop(columns=cols), batch_size=None, batch_format="pandas"
+        )
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda df: df[list(cols)], batch_size=None, batch_format="pandas"
+        )
+
+    def add_column(self, name: str, fn: Callable[[pd.DataFrame], Any]) -> "Dataset":
+        def batch_fn(df: pd.DataFrame) -> pd.DataFrame:
+            df = df.copy()
+            df[name] = fn(df)
+            return df
+
+        return self.map_batches(batch_fn, batch_size=None, batch_format="pandas")
+
+    # -- shape ops ----------------------------------------------------------
+    def limit(self, n: int) -> "Dataset":
+        """First n rows (SMALL_DATA dial, Model_finetuning…ipynb:cc-21)."""
+        refs: List[ObjectRef] = []
+        remaining = n
+        for ref in self._block_refs:
+            if remaining <= 0:
+                break
+            blk = get(ref)
+            rows = B.block_num_rows(blk)
+            if rows <= remaining:
+                refs.append(ref)
+                remaining -= rows
+            else:
+                refs.append(put(B.block_slice(blk, 0, remaining)))
+                remaining = 0
+        return Dataset(refs)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Rebalance into exactly ``num_blocks`` blocks
+        (Introduction…ipynb:cc-11)."""
+        df = self.to_pandas()
+        n = len(df)
+        if n == 0:
+            return Dataset([put(B.block_from_pandas(df)) for _ in range(1)])
+        sizes = [(n + i) // num_blocks for i in range(num_blocks)]
+        refs, start = [], 0
+        for s in sizes:
+            refs.append(put(B.block_from_pandas(df.iloc[start : start + s])))
+            start += s
+        return Dataset(refs)
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        from .io import df_chunks
+
+        df = self.to_pandas()
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(len(df))
+        df = df.iloc[perm].reset_index(drop=True)
+        nb = max(1, self.num_blocks())
+        return Dataset([put(B.block_from_pandas(part)) for part in df_chunks(df, nb)])
+
+    def train_test_split(
+        self, test_size: Union[float, int], *, shuffle: bool = False,
+        seed: Optional[int] = None,
+    ) -> Tuple["Dataset", "Dataset"]:
+        """80/20-style split (Introduction…ipynb:cc-10; the HF-side
+        ``train_test_split(seed=57)`` at Model_finetuning…ipynb:cc-13)."""
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        n = ds.count()
+        ntest = int(n * test_size) if isinstance(test_size, float) else int(test_size)
+        ntrain = n - ntest
+        df = ds.to_pandas()
+        train = Dataset([put(B.block_from_pandas(df.iloc[:ntrain]))])
+        test = Dataset([put(B.block_from_pandas(df.iloc[ntrain:]))])
+        return train, test
+
+    def split(self, n: int, *, equal: bool = True, locality_hints=None) -> List["Dataset"]:
+        """Split into n shards — one per DP worker (SURVEY.md §1-L3:
+        "partitioned Dataset shards" per worker)."""
+        from .io import df_chunks
+
+        df = self.to_pandas()
+        total = len(df)
+        if equal:
+            per = total // n
+            parts = [df.iloc[i * per : (i + 1) * per] for i in range(n)]
+        else:
+            parts = df_chunks(df, n)
+        return [Dataset([put(B.block_from_pandas(p))]) for p in parts]
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._block_refs)
+        for o in others:
+            refs.extend(o._block_refs)
+        return Dataset(refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left, right = self.to_pandas(), other.to_pandas()
+        right = right.rename(
+            columns={c: f"{c}_1" for c in right.columns if c in left.columns}
+        )
+        return Dataset([put(B.block_from_pandas(pd.concat([left, right], axis=1)))])
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        df = self.to_pandas().sort_values(key, ascending=not descending)
+        return Dataset([put(B.block_from_pandas(df.reset_index(drop=True)))])
+
+    def groupby(self, key: str) -> "GroupedData":
+        """(Introduction…ipynb:cc-18: ``groupby("…").mean("…")``)."""
+        return GroupedData(self, key)
+
+    # -- writes -------------------------------------------------------------
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, blk in enumerate(self._blocks()):
+            table = (
+                blk
+                if isinstance(blk, pa.Table)
+                else pa.Table.from_pandas(B.block_to_pandas(blk), preserve_index=False)
+            )
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, blk in enumerate(self._blocks()):
+            B.block_to_pandas(blk).to_csv(
+                os.path.join(path, f"part-{i:05d}.csv"), index=False
+            )
+
+    def materialize(self) -> "Dataset":
+        return self
+
+
+class GroupedData:
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, how: str, on: Optional[str]) -> Dataset:
+        df = self._ds.to_pandas()
+        g = df.groupby(self._key)
+        target = g[on] if on else g
+        out = getattr(target, how)()
+        if isinstance(out, pd.Series):
+            out = out.to_frame(name=f"{how}({on})" if on else how)
+        else:
+            out = out.rename(columns={c: f"{how}({c})" for c in out.columns})
+        out = out.reset_index()
+        return Dataset([put(B.block_from_pandas(out))])
+
+    def mean(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("mean", on)
+
+    def sum(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("sum", on)
+
+    def min(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("min", on)
+
+    def max(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("max", on)
+
+    def std(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("std", on)
+
+    def count(self) -> Dataset:
+        df = self._ds.to_pandas()
+        out = df.groupby(self._key).size().to_frame("count()").reset_index()
+        return Dataset([put(B.block_from_pandas(out))])

@@ -1,0 +1,25 @@
+"""tpu_air.parallel — meshes, sub-mesh leases, host collectives (L6 comm)."""
+
+from .collectives import Barrier, allreduce, broadcast
+from .mesh import (
+    batch_sharding,
+    data_parallel_mesh,
+    leased_chip_ids,
+    make_mesh,
+    replicated_sharding,
+    topology,
+    visible_devices,
+)
+
+__all__ = [
+    "Barrier",
+    "allreduce",
+    "batch_sharding",
+    "broadcast",
+    "data_parallel_mesh",
+    "leased_chip_ids",
+    "make_mesh",
+    "replicated_sharding",
+    "topology",
+    "visible_devices",
+]
