@@ -4,18 +4,51 @@ Per SURVEY.md §4.3 the reference's distributed tests run "multi-node without a
 cluster" (CPU Gloo DDP).  The TPU-native analog: run every test on XLA:CPU
 with a virtual 8-device mesh so pjit/shard_map paths execute real collectives
 without TPU hardware.
+
+This environment injects a TPU PJRT plugin via sitecustomize (gated on
+PALLAS_AXON_POOL_IPS) that, once registered, initializes the real-TPU tunnel
+even under JAX_PLATFORMS=cpu.  Tests must never touch the tunnel, so if the
+plugin got registered at interpreter start we re-exec pytest once with the
+plugin disabled and the CPU mesh configured.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("TPU_AIR_NUM_CHIPS", "8")
+_WANT_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "TPU_AIR_NUM_CHIPS": "8",
+}
+
+
+def _needs_reexec() -> bool:
+    if os.environ.get("TPU_AIR_TEST_REEXEC") == "1":
+        return False
+    # NB: the sitecustomize imports jax at interpreter start, but backends
+    # initialize lazily — re-exec is safe until a backend is live.
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or any(
+        os.environ.get(k) != v for k, v in _WANT_ENV.items()
+    )
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    # pytest's fd-level capture has already replaced fd 1/2 — restore them
+    # before exec or the re-exec'd run writes into a dead temp file.
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        try:
+            capman.stop_global_capturing()
+        except Exception:
+            pass
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize gate for TPU plugin
+    env.update(_WANT_ENV)
+    env["TPU_AIR_TEST_REEXEC"] = "1"
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *config.invocation_params.args], env)
+
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,199 @@
+"""Flax T5 tests: shapes, loss, jit generate, and numerical parity against
+the torch reference implementation (transformers, random tiny weights — no
+network)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_air.models import ByteTokenizer
+from tpu_air.models.t5 import (
+    T5Config,
+    T5ForConditionalGeneration,
+    convert_t5_state_dict,
+    cross_entropy_loss,
+    generate,
+    shift_right,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    rng = jax.random.PRNGKey(0)
+    enc = jnp.ones((2, 8), jnp.int32)
+    dec = jnp.ones((2, 6), jnp.int32)
+    params = model.init(rng, enc, jnp.ones_like(enc), dec)["params"]
+    return cfg, model, params
+
+
+def test_forward_shapes(tiny):
+    cfg, model, params = tiny
+    logits = model.apply(
+        {"params": params},
+        jnp.ones((3, 10), jnp.int32),
+        jnp.ones((3, 10), jnp.int32),
+        jnp.ones((3, 5), jnp.int32),
+    )
+    assert logits.shape == (3, 5, cfg.vocab_size)
+
+
+def test_shift_right():
+    labels = jnp.array([[5, 6, 7], [8, 9, 0]])
+    out = shift_right(labels, decoder_start_token_id=0, pad_token_id=0)
+    np.testing.assert_array_equal(out, [[0, 5, 6], [0, 8, 9]])
+
+
+def test_loss_masks_padding(tiny):
+    cfg, model, params = tiny
+    logits = jnp.zeros((1, 4, cfg.vocab_size))
+    labels = jnp.array([[5, 6, 0, 0]])  # two pad positions
+    loss, ntok = cross_entropy_loss(logits, labels, pad_token_id=0)
+    assert ntok == 2
+    assert loss == pytest.approx(np.log(cfg.vocab_size), rel=1e-4)
+
+
+def test_generate_greedy_jit(tiny):
+    cfg, model, params = tiny
+    ids = jnp.array([[4, 5, 6, 1, 0, 0]], dtype=jnp.int32)
+    out = generate(model, params, ids, max_new_tokens=7)
+    assert out.shape == (1, 7)
+    # deterministic: same input → same output
+    out2 = generate(model, params, ids, max_new_tokens=7)
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_generate_incremental_matches_full_forward(tiny):
+    """The KV-cache decode must agree with the non-cached forward pass:
+    greedy tokens from generate == argmax chain from full forwards."""
+    cfg, model, params = tiny
+    ids = jnp.array([[7, 8, 9, 2, 1]], dtype=jnp.int32)
+    mask = jnp.ones_like(ids)
+    steps = 5
+    toks = generate(model, params, ids, max_new_tokens=steps)
+
+    # replay with full (uncached) decoder forwards
+    dec = jnp.full((1, 1), cfg.decoder_start_token_id, dtype=jnp.int32)
+    chain = []
+    for _ in range(steps):
+        logits = model.apply({"params": params}, ids, mask, dec)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        chain.append(nxt)
+        dec = jnp.concatenate([dec, jnp.array([[nxt]], dtype=jnp.int32)], axis=1)
+        if nxt == cfg.eos_token_id:
+            break
+    got = [int(t) for t in np.asarray(toks[0])][: len(chain)]
+    assert got == chain
+
+
+def test_sampling_generate_runs(tiny):
+    cfg, model, params = tiny
+    ids = jnp.array([[4, 5, 6, 1]], dtype=jnp.int32)
+    out = generate(
+        model, params, ids, max_new_tokens=4, do_sample=True, temperature=0.8,
+        top_k=10, rng=jax.random.PRNGKey(7),
+    )
+    assert out.shape == (1, 4)
+
+
+# -- torch parity oracle -----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def torch_pair():
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=384, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+        num_heads=4, feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+        dropout_rate=0.0, decoder_start_token_id=0, pad_token_id=0,
+        eos_token_id=1,
+    )
+    transformers.set_seed(42)
+    torch_model = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    cfg = T5Config.tiny()
+    cfg.dropout_rate = 0.0
+    sd = {k: v.detach().numpy() for k, v in torch_model.state_dict().items()}
+    params = jax.tree_util.tree_map(
+        jnp.asarray, convert_t5_state_dict(sd, cfg)
+    )
+    model = T5ForConditionalGeneration(cfg)
+    return torch_model, model, params
+
+
+def test_forward_parity_with_torch(torch_pair):
+    import torch
+
+    torch_model, model, params = torch_pair
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 300, (2, 12))
+    mask = np.ones_like(ids)
+    mask[1, 9:] = 0
+    dec = rng.integers(3, 300, (2, 7))
+
+    with torch.no_grad():
+        ref = torch_model(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(mask),
+            decoder_input_ids=torch.tensor(dec),
+        ).logits.numpy()
+
+    got = np.asarray(
+        model.apply(
+            {"params": params},
+            jnp.asarray(ids, jnp.int32),
+            jnp.asarray(mask, jnp.int32),
+            jnp.asarray(dec, jnp.int32),
+        )
+    )
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_generate_parity_with_torch(torch_pair):
+    import torch
+
+    torch_model, model, params = torch_pair
+    ids = np.array([[10, 20, 30, 40, 1]], dtype=np.int64)
+    mask = np.ones_like(ids)
+    with torch.no_grad():
+        ref = torch_model.generate(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(mask),
+            max_new_tokens=8,
+            do_sample=False,
+            num_beams=1,
+        ).numpy()[0]
+    got = np.asarray(
+        generate(model, params, jnp.asarray(ids, jnp.int32), max_new_tokens=8)
+    )[0]
+    # HF output includes the leading decoder_start token; strip it and
+    # compare up to EOS/padding.
+    ref_toks = [int(t) for t in ref[1:]]
+    got_toks = [int(t) for t in got]
+    n = min(len(ref_toks), len(got_toks))
+    assert got_toks[:n] == ref_toks[:n]
+
+
+# -- tokenizer ---------------------------------------------------------------
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    enc = tok(["hello world", "héllo"], max_length=16, padding="max_length",
+              truncation=True, return_tensors="np")
+    assert enc["input_ids"].shape == (2, 16)
+    assert enc["attention_mask"][0].sum() == len("hello world") + 1  # +eos
+    out = tok.batch_decode(enc["input_ids"])
+    assert out[0] == "hello world"
+    assert out[1] == "héllo"
+
+
+def test_byte_tokenizer_save_load(tmp_path):
+    tok = ByteTokenizer(model_max_length=77)
+    tok.save_pretrained(str(tmp_path))
+    tok2 = ByteTokenizer.from_pretrained(str(tmp_path))
+    assert tok2.model_max_length == 77

@@ -1,0 +1,22 @@
+"""Flax T5 / FLAN-T5 model family."""
+
+from .config import T5Config
+from .generate import generate, make_generate_fn
+from .hf_import import config_from_hf, convert_t5_state_dict, load_t5_from_hf
+from .modeling import (
+    T5ForConditionalGeneration,
+    cross_entropy_loss,
+    shift_right,
+)
+
+__all__ = [
+    "T5Config",
+    "T5ForConditionalGeneration",
+    "config_from_hf",
+    "convert_t5_state_dict",
+    "cross_entropy_loss",
+    "generate",
+    "load_t5_from_hf",
+    "make_generate_fn",
+    "shift_right",
+]

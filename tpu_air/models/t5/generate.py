@@ -1,0 +1,131 @@
+"""Autoregressive generation under jit.
+
+The reference calls torch ``model.generate(**inputs, max_new_tokens=128)``
+(predictor.py:102; Model_finetuning…ipynb:cc-67).  TPU-native version: a
+fixed-shape `lax.scan` decode loop over a pre-allocated KV cache — no Python
+control flow, no recompiles across batches of the same shape (SURVEY.md §7
+hard-part 2).  Cache tensors are built with `jax.eval_shape`, so cache
+construction costs nothing.
+
+Greedy decoding is the default (matching the reference's ``generate`` call,
+which passes no sampling flags); temperature/top-k sampling is available.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import T5Config
+from .modeling import T5ForConditionalGeneration
+
+
+def init_cache(model, batch_size: int, max_decode_len: int, enc_hidden, enc_mask):
+    """Zero-filled decode cache with the right structure, via eval_shape."""
+
+    def _init():
+        return model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch_size, max_decode_len), jnp.int32),
+            enc_hidden,
+            enc_mask,
+            decode=True,
+            method=model.decode,
+        )
+
+    shapes = jax.eval_shape(_init)["cache"]
+    return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def _sample_token(logits, rng, do_sample: bool, temperature: float, top_k: int):
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def make_generate_fn(
+    model: T5ForConditionalGeneration,
+    max_new_tokens: int = 128,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: int = 0,
+):
+    """Build a jit-compiled ``(params, input_ids, attention_mask, rng) ->
+    sequences`` function with a fixed decode budget."""
+    cfg: T5Config = model.config
+    start_id = cfg.decoder_start_token_id
+    eos_id = cfg.eos_token_id
+    pad_id = cfg.pad_token_id
+
+    @jax.jit
+    def generate_fn(params, input_ids, attention_mask, rng):
+        batch = input_ids.shape[0]
+        enc = model.apply(
+            {"params": params}, input_ids, attention_mask, method=model.encode
+        )
+        cache = init_cache(model, batch, max_new_tokens + 1, enc, attention_mask)
+        tok0 = jnp.full((batch,), start_id, dtype=jnp.int32)
+        finished0 = jnp.zeros((batch,), dtype=jnp.bool_)
+
+        def step(carry, _):
+            tok, cache, finished, rng = carry
+            logits, vars_out = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None],
+                enc,
+                attention_mask,
+                decode=True,
+                mutable=["cache"],
+                method=model.decode,
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _sample_token(
+                logits[:, -1, :], sub, do_sample, temperature, top_k
+            )
+            nxt = jnp.where(finished, pad_id, nxt)
+            finished = finished | (nxt == eos_id)
+            return (nxt, vars_out["cache"], finished, rng), nxt
+
+        (_, _, _, _), toks = jax.lax.scan(
+            step, (tok0, cache, finished0, rng), None, length=max_new_tokens
+        )
+        return jnp.transpose(toks)  # [batch, max_new_tokens]
+
+    return generate_fn
+
+
+_GEN_CACHE: Dict[Tuple, Any] = {}
+
+
+def generate(
+    model: T5ForConditionalGeneration,
+    params,
+    input_ids,
+    attention_mask=None,
+    max_new_tokens: int = 128,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    rng: Optional[jax.Array] = None,
+):
+    """Convenience wrapper caching compiled generate fns per config."""
+    input_ids = jnp.asarray(input_ids, dtype=jnp.int32)
+    if attention_mask is None:
+        attention_mask = (input_ids != model.config.pad_token_id).astype(jnp.int32)
+    else:
+        attention_mask = jnp.asarray(attention_mask, dtype=jnp.int32)
+    key = (id(model), max_new_tokens, do_sample, temperature, top_k)
+    if key not in _GEN_CACHE:
+        _GEN_CACHE[key] = make_generate_fn(
+            model, max_new_tokens, do_sample, temperature, top_k
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _GEN_CACHE[key](params, input_ids, attention_mask, rng)

@@ -1,0 +1,141 @@
+"""HF → Flax weight import for T5.
+
+SURVEY.md §7 hard-part 4: bit-faithful import of HF torch weights into this
+framework's param tree so `google/flan-t5-*` checkpoints load directly
+(Model_finetuning…ipynb:cc-25 loads them via transformers).  Pure-numpy
+conversion — torch is only needed to *read* the source state dict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .config import T5Config
+
+
+def _t(w) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(w).T)
+
+
+def _attn_in(w, heads: int, d_kv: int) -> np.ndarray:
+    # torch [heads*d_kv, d_model] → DenseGeneral kernel [d_model, heads, d_kv]
+    w = np.asarray(w)
+    return np.ascontiguousarray(w.T.reshape(w.shape[1], heads, d_kv))
+
+
+def _attn_out(w, heads: int, d_kv: int) -> np.ndarray:
+    # torch [d_model, heads*d_kv] → DenseGeneral kernel [heads, d_kv, d_model]
+    w = np.asarray(w)
+    return np.ascontiguousarray(w.T.reshape(heads, d_kv, w.shape[0]))
+
+
+def convert_t5_state_dict(sd: Dict[str, Any], config: T5Config) -> Dict[str, Any]:
+    """Map an HF torch T5 state_dict (numpy-convertible values) onto this
+    framework's param tree."""
+    h, dkv = config.num_heads, config.d_kv
+    sd = {k: np.asarray(v) for k, v in sd.items()}
+    params: Dict[str, Any] = {"shared": {"embedding": sd["shared.weight"]}}
+
+    def attn(prefix: str) -> Dict[str, Any]:
+        return {
+            "q": {"kernel": _attn_in(sd[f"{prefix}.q.weight"], h, dkv)},
+            "k": {"kernel": _attn_in(sd[f"{prefix}.k.weight"], h, dkv)},
+            "v": {"kernel": _attn_in(sd[f"{prefix}.v.weight"], h, dkv)},
+            "o": {"kernel": _attn_out(sd[f"{prefix}.o.weight"], h, dkv)},
+        }
+
+    def mlp(prefix: str) -> Dict[str, Any]:
+        out = {"wo": {"kernel": _t(sd[f"{prefix}.wo.weight"])}}
+        if config.is_gated_act:
+            out["wi_0"] = {"kernel": _t(sd[f"{prefix}.wi_0.weight"])}
+            out["wi_1"] = {"kernel": _t(sd[f"{prefix}.wi_1.weight"])}
+        else:
+            out["wi"] = {"kernel": _t(sd[f"{prefix}.wi.weight"])}
+        return out
+
+    enc: Dict[str, Any] = {
+        "rel_bias": {
+            "embedding": sd[
+                "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+            ]
+        },
+        "final_ln": {"weight": sd["encoder.final_layer_norm.weight"]},
+    }
+    for i in range(config.num_layers):
+        b = f"encoder.block.{i}"
+        enc[f"layer_{i}"] = {
+            "self_attn": attn(f"{b}.layer.0.SelfAttention"),
+            "ln_self": {"weight": sd[f"{b}.layer.0.layer_norm.weight"]},
+            "mlp": mlp(f"{b}.layer.1.DenseReluDense"),
+            "ln_mlp": {"weight": sd[f"{b}.layer.1.layer_norm.weight"]},
+        }
+    params["encoder"] = enc
+
+    dec: Dict[str, Any] = {
+        "rel_bias": {
+            "embedding": sd[
+                "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+            ]
+        },
+        "final_ln": {"weight": sd["decoder.final_layer_norm.weight"]},
+    }
+    for i in range(config.num_decoder_layers):
+        b = f"decoder.block.{i}"
+        dec[f"layer_{i}"] = {
+            "self_attn": attn(f"{b}.layer.0.SelfAttention"),
+            "ln_self": {"weight": sd[f"{b}.layer.0.layer_norm.weight"]},
+            "cross_attn": attn(f"{b}.layer.1.EncDecAttention"),
+            "ln_cross": {"weight": sd[f"{b}.layer.1.layer_norm.weight"]},
+            "mlp": mlp(f"{b}.layer.2.DenseReluDense"),
+            "ln_mlp": {"weight": sd[f"{b}.layer.2.layer_norm.weight"]},
+        }
+    params["decoder"] = dec
+
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"kernel": _t(sd["lm_head.weight"])}
+    return params
+
+
+def config_from_hf(hf_config) -> T5Config:
+    return T5Config(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.d_model,
+        d_kv=hf_config.d_kv,
+        d_ff=hf_config.d_ff,
+        num_layers=hf_config.num_layers,
+        num_decoder_layers=hf_config.num_decoder_layers,
+        num_heads=hf_config.num_heads,
+        relative_attention_num_buckets=hf_config.relative_attention_num_buckets,
+        relative_attention_max_distance=getattr(
+            hf_config, "relative_attention_max_distance", 128
+        ),
+        dropout_rate=hf_config.dropout_rate,
+        layer_norm_epsilon=hf_config.layer_norm_epsilon,
+        feed_forward_proj=hf_config.feed_forward_proj.replace("gated-gelu_new", "gated-gelu"),
+        tie_word_embeddings=hf_config.tie_word_embeddings,
+        pad_token_id=hf_config.pad_token_id,
+        eos_token_id=hf_config.eos_token_id,
+        decoder_start_token_id=hf_config.decoder_start_token_id,
+    )
+
+
+def load_t5_from_hf(name_or_path: str, dtype: str = "float32"):
+    """Load a (local) HF T5 checkpoint into (model, params).  Network
+    availability is the caller's concern — in air-gapped environments point
+    this at a downloaded directory."""
+    from transformers import T5ForConditionalGeneration as TorchT5
+
+    from .modeling import T5ForConditionalGeneration
+
+    torch_model = TorchT5.from_pretrained(name_or_path)
+    config = config_from_hf(torch_model.config)
+    config.dtype = dtype
+    sd = {k: v.detach().cpu().numpy() for k, v in torch_model.state_dict().items()}
+    params = convert_t5_state_dict(sd, config)
+    model = T5ForConditionalGeneration(config)
+    import jax.numpy as jnp
+
+    params = __import__("jax").tree_util.tree_map(lambda x: jnp.asarray(x), params)
+    return model, params
