@@ -15,11 +15,19 @@ plugin disabled and the CPU mesh configured.
 import os
 import sys
 
-_WANT_ENV = {
-    "JAX_PLATFORMS": "cpu",
-    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-    "TPU_AIR_NUM_CHIPS": "8",
-}
+_HOST_DEVICES_FLAG = "--xla_force_host_platform_device_count=8"
+
+
+def _want_env() -> dict:
+    # preserve any user-supplied XLA_FLAGS, only appending the device-count
+    xla = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla:
+        xla = f"{xla} {_HOST_DEVICES_FLAG}".strip()
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": xla,
+        "TPU_AIR_NUM_CHIPS": os.environ.get("TPU_AIR_NUM_CHIPS", "8"),
+    }
 
 
 def _needs_reexec() -> bool:
@@ -28,7 +36,7 @@ def _needs_reexec() -> bool:
     # NB: the sitecustomize imports jax at interpreter start, but backends
     # initialize lazily — re-exec is safe until a backend is live.
     return bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or any(
-        os.environ.get(k) != v for k, v in _WANT_ENV.items()
+        os.environ.get(k) != v for k, v in _want_env().items()
     )
 
 
@@ -45,7 +53,7 @@ def pytest_configure(config):
             pass
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize gate for TPU plugin
-    env.update(_WANT_ENV)
+    env.update(_want_env())
     env["TPU_AIR_TEST_REEXEC"] = "1"
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *config.invocation_params.args], env)
 

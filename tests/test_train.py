@@ -1,0 +1,222 @@
+"""Train layer tests — the minimum end-to-end slice (SURVEY.md §7 stage 5):
+W1 (fine-tune) + W4 (generate from checkpoint) at test dials, on the virtual
+8-device CPU mesh."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tpu_air
+from tpu_air import data as tad
+from tpu_air.data import BatchMapper
+from tpu_air.models import ByteTokenizer
+from tpu_air.models.t5 import T5Config
+from tpu_air.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    T5Trainer,
+    TrainingArguments,
+    XGBoostTrainer,
+)
+
+SEQ = 24
+
+
+def make_alpaca_like(n=64):
+    rows = [
+        {"instruction": f"repeat the word w{i % 7}", "output": f"w{i % 7}"}
+        for i in range(n)
+    ]
+    return tad.from_items(rows)
+
+
+def tokenize_preprocessor():
+    tok = ByteTokenizer(model_max_length=SEQ)
+
+    def preprocess_function(df: pd.DataFrame) -> pd.DataFrame:
+        # mirrors the reference preprocessor shape (utils.py:6-33): tokenizer
+        # constructed inside the fn (runs on data workers), inputs padded to
+        # max_length, labels from the target text
+        t = ByteTokenizer(model_max_length=SEQ)
+        enc = t(list(df["instruction"]), max_length=SEQ, padding="max_length",
+                truncation=True, return_tensors="np")
+        lab = t(list(df["output"]), max_length=SEQ, padding="max_length",
+                truncation=True, return_tensors="np")
+        return pd.DataFrame(
+            {
+                "input_ids": list(enc["input_ids"]),
+                "attention_mask": list(enc["attention_mask"]),
+                "labels": list(lab["input_ids"]),
+            }
+        )
+
+    return tok, BatchMapper(preprocess_function, batch_format="pandas", batch_size=4096)
+
+
+@pytest.fixture(scope="module")
+def trained_result(air):
+    ds = make_alpaca_like(64)
+    train_ds, eval_ds = ds.train_test_split(0.25)
+    tok, pp = tokenize_preprocessor()
+    trainer = T5Trainer(
+        model_config=T5Config.tiny(vocab_size=384),
+        training_args=TrainingArguments(
+            learning_rate=3e-3,
+            per_device_train_batch_size=2,
+            num_train_epochs=2,
+            weight_decay=0.0,
+        ),
+        tokenizer=tok,
+        scaling_config=ScalingConfig(num_workers=4, num_chips_per_worker=1),
+        datasets={"train": train_ds, "evaluation": eval_ds},
+        run_config=RunConfig(
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=1,
+                checkpoint_score_attribute="eval_loss",
+                checkpoint_score_order="min",
+            )
+        ),
+        preprocessor=pp,
+    )
+    return trainer.fit()
+
+
+def test_fit_returns_metrics_and_checkpoint(trained_result):
+    r = trained_result
+    assert r.error is None
+    assert r.checkpoint is not None
+    assert "loss" in r.metrics and "eval_loss" in r.metrics
+    assert len(r.metrics_history) == 2  # one report per epoch
+    assert r.metrics["epoch"] == 2
+
+
+def test_loss_decreases(trained_result):
+    h = trained_result.metrics_history
+    assert h[-1]["loss"] < h[0]["loss"]
+
+
+def test_checkpoint_bundles_everything(trained_result):
+    """SURVEY.md §5: checkpoint = model + tokenizer + fitted preprocessor."""
+    ckpt = trained_result.checkpoint
+    model, params = ckpt.get_model()
+    assert model.config.d_model == 64
+    tok = ckpt.get_tokenizer(ByteTokenizer)
+    assert tok.model_max_length == SEQ
+    pp = ckpt.get_preprocessor()
+    assert pp is not None
+    out = pp.transform_batch(pd.DataFrame({"instruction": ["hi"], "output": ["yo"]}))
+    assert "input_ids" in out.columns
+
+
+def test_generate_from_checkpoint(trained_result):
+    """W4: single-example interactive generate from the fit checkpoint
+    (Model_finetuning…ipynb:cc-49)."""
+    from tpu_air.models.t5 import generate
+
+    ckpt = trained_result.checkpoint
+    model, params = ckpt.get_model()
+    tok = ckpt.get_tokenizer(ByteTokenizer)
+    enc = tok(["repeat the word w3"], max_length=SEQ, padding="max_length",
+              truncation=True, return_tensors="np")
+    out = generate(model, params, enc["input_ids"], enc["attention_mask"],
+                   max_new_tokens=8)
+    text = tok.batch_decode(out)[0]
+    assert isinstance(text, str)
+
+
+def test_checkpoint_dtype_morphing(trained_result):
+    """bf16-at-load (the fp16/device_map analog, cc-64)."""
+    import jax.numpy as jnp
+
+    params = trained_result.checkpoint.get_params(dtype="bfloat16")
+    leaf = params["shared"]["embedding"]
+    assert leaf.dtype == jnp.bfloat16
+
+
+def test_jax_function_trainer(air):
+    """Generic train_loop_per_worker surface (session API)."""
+
+    def loop(config):
+        from tpu_air.train import session
+
+        ds = session.get_dataset_shard("train")
+        total = ds.count()
+        for i in range(3):
+            session.report({"seen": total, "metric": float(10 - i)})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"x": 1},
+        scaling_config=ScalingConfig(num_workers=1),
+        datasets={"train": tad.range(10)},
+    )
+    r = trainer.fit()
+    assert r.error is None
+    assert r.metrics["seen"] == 10
+    assert len(r.metrics_history) == 3
+
+
+def test_trainer_error_surfaces(air):
+    def loop(config):
+        raise RuntimeError("explode")
+
+    r = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1)).fit()
+    assert r.error is not None
+    assert "explode" in str(r.error)
+
+
+def test_failure_retry_resumes_from_checkpoint(air):
+    """SURVEY.md §5 failure detection: restart from latest checkpoint."""
+
+    def loop(config):
+        from tpu_air.train import session
+
+        start = 0
+        if config.get("resume_from_checkpoint"):
+            ck = Checkpoint.from_directory(config["resume_from_checkpoint"])
+            start = ck.get_metrics()["i"]
+        for i in range(start, 4):
+            ck = Checkpoint.from_model(metrics={"i": i + 1})
+            session.report({"i": i + 1}, checkpoint=ck)
+            if i == 1 and start == 0:
+                raise RuntimeError("simulated crash")
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert r.error is None
+    assert r.metrics["i"] == 4
+
+
+def test_gbdt_trainer_w8(air):
+    """W8 tabular capability: XGBoostTrainer-equivalent with the reference's
+    param surface and metric names (Introduction…ipynb:cc-32,40)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    df = pd.DataFrame(X, columns=list("abcd"))
+    df["is_big_tip"] = y
+    train_df, valid_df = df.iloc[:240], df.iloc[240:]
+    trainer = XGBoostTrainer(
+        label_column="is_big_tip",
+        num_boost_round=8,
+        params={"objective": "binary:logistic", "eta": 0.3, "max_depth": 3},
+        scaling_config=ScalingConfig(num_workers=2, use_tpu=False),
+        datasets={
+            "train": tad.from_pandas(train_df),
+            "valid": tad.from_pandas(valid_df),
+        },
+    )
+    r = trainer.fit()
+    assert r.error is None
+    assert "train-logloss" in r.metrics and "valid-error" in r.metrics
+    assert r.metrics["train-error"] < 0.2
+    assert r.checkpoint is not None
+    est = r.checkpoint.get_model()
+    assert hasattr(est, "predict_proba")

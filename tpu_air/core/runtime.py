@@ -312,10 +312,20 @@ class Runtime:
             self._spawn_worker()
 
     # -- worker management -------------------------------------------------
+    def _pick_ctx(self):
+        """fork is fast, but forking after a JAX/XLA backend is live in this
+        process inherits dead compiler threadpools → child deadlocks on its
+        first jax op.  Switch to spawn once a backend exists."""
+        if self.mp_ctx.get_start_method() == "fork":
+            xb = sys.modules.get("jax._src.xla_bridge")
+            if xb is not None and getattr(xb, "_backends", None):
+                return mp.get_context("spawn")
+        return self.mp_ctx
+
     def _spawn_worker(self, actor_id: Optional[str] = None) -> _WorkerState:
         wid = next(self._next_worker_id)
         parent, child = mp.Pipe(duplex=True)
-        proc = self.mp_ctx.Process(
+        proc = self._pick_ctx().Process(
             target=_worker_main,
             args=(wid, self.store_root, child),
             daemon=True,

@@ -102,6 +102,7 @@ def make_generate_fn(
 
 
 _GEN_CACHE: Dict[Tuple, Any] = {}
+_GEN_CACHE_MAX = 16
 
 
 def generate(
@@ -121,8 +122,13 @@ def generate(
         attention_mask = (input_ids != model.config.pad_token_id).astype(jnp.int32)
     else:
         attention_mask = jnp.asarray(attention_mask, dtype=jnp.int32)
-    key = (id(model), max_new_tokens, do_sample, temperature, top_k)
+    # key by config content, not id(model): model objects are rebuilt per
+    # Checkpoint.get_model() call and ids can be reused after GC
+    cfg_key = tuple(sorted(model.config.to_dict().items()))
+    key = (cfg_key, max_new_tokens, do_sample, temperature, top_k)
     if key not in _GEN_CACHE:
+        if len(_GEN_CACHE) >= _GEN_CACHE_MAX:
+            _GEN_CACHE.pop(next(iter(_GEN_CACHE)))
         _GEN_CACHE[key] = make_generate_fn(
             model, max_new_tokens, do_sample, temperature, top_k
         )

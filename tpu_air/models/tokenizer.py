@@ -64,12 +64,19 @@ class ByteTokenizer:
         else:
             width = None
         if width is not None:
-            attn = [[1] * min(len(s), width) + [0] * max(0, width - len(s)) for s in seqs]
-            seqs = [s[:width] + [self.PAD] * max(0, width - len(s)) for s in seqs]
+            # like HF: padding never truncates — over-length sequences stay
+            # full length unless truncation=True was passed
+            attn = [[1] * len(s) + [0] * max(0, width - len(s)) for s in seqs]
+            seqs = [s + [self.PAD] * max(0, width - len(s)) for s in seqs]
         else:
             attn = [[1] * len(s) for s in seqs]
         out = {"input_ids": seqs, "attention_mask": attn}
         if return_tensors in ("np", "jax"):
+            if len({len(s) for s in seqs}) > 1:
+                raise ValueError(
+                    "ragged sequences cannot become tensors — pass "
+                    "truncation=True (some inputs exceed max_length)"
+                )
             out = {k: np.asarray(v, dtype=np.int32) for k, v in out.items()}
         return out
 

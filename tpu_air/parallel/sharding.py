@@ -1,0 +1,83 @@
+"""Parameter partitioning rules (DP / TP as config choices).
+
+The reference's only training parallelism is DP (SURVEY.md §2C); pjit makes a
+``model`` (tensor-parallel) axis nearly free, so the T5 param tree carries
+path-based partition rules: MLP and attention-head matmuls shard over the
+``model`` axis, everything else replicates.  With tp=1 every spec collapses
+to replication and this is pure DP.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def t5_param_spec(path_names, leaf) -> P:
+    """PartitionSpec for one T5 param, by its tree path."""
+    names = [str(p) for p in path_names]
+    leafname = names[-1]
+    parent = names[-2] if len(names) >= 2 else ""
+    grand = names[-3] if len(names) >= 3 else ""
+    if leafname == "kernel":
+        if parent in ("wi", "wi_0", "wi_1"):
+            return P(None, "model")           # [d_model, d_ff]
+        if parent == "wo":
+            return P("model", None)           # [d_ff, d_model]
+        if parent in ("q", "k", "v"):
+            return P(None, "model", None)     # [d_model, heads, d_kv]
+        if parent == "o":
+            return P("model", None, None)     # [heads, d_kv, d_model]
+        if parent == "lm_head":
+            return P(None, "model")           # [d_model, vocab]
+    return P()  # embeddings, norms, rel-bias: replicated
+
+
+def _path_names(path) -> list:
+    out = []
+    for p in path:
+        if hasattr(p, "key"):
+            out.append(p.key)
+        elif hasattr(p, "name"):
+            out.append(p.name)
+        else:
+            out.append(str(p))
+    return out
+
+
+def t5_param_shardings(params, mesh) -> Any:
+    """NamedSharding tree for a T5 param tree over ``mesh`` (axes
+    ("data","model"); "model" may be absent → replication)."""
+    has_model = "model" in mesh.axis_names
+
+    def spec_for(path, leaf):
+        if not has_model:
+            return NamedSharding(mesh, P())
+        spec = t5_param_spec(_path_names(path), leaf)
+        # drop specs that don't divide evenly — XLA requires divisibility
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        ok = []
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                ok.append(None)
+            elif leaf.shape[dim] % sizes.get(axis, 1) == 0:
+                ok.append(axis)
+            else:
+                ok.append(None)
+        return NamedSharding(mesh, P(*ok))
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def replicate(tree, mesh):
+    sh = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def shard_params(params, mesh):
+    shardings = t5_param_shardings(params, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), params, shardings
+    )

@@ -1,0 +1,30 @@
+"""tpu_air.train — trainers, configs, checkpoints, session (L3)."""
+
+from . import session
+from .checkpoint import Checkpoint
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .gbdt_trainer import GBDTTrainer, XGBoostTrainer
+from .result import Result
+from .session import get_dataset_shard, get_session, report
+from .t5_trainer import T5Trainer, TrainingArguments, t5_train_loop
+from .trainer import BaseTrainer, JaxTrainer
+
+__all__ = [
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "GBDTTrainer",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "T5Trainer",
+    "TrainingArguments",
+    "XGBoostTrainer",
+    "get_dataset_shard",
+    "get_session",
+    "report",
+    "session",
+    "t5_train_loop",
+]

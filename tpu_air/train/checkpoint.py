@@ -1,0 +1,261 @@
+"""Checkpoint — the inter-stage currency of the framework.
+
+SURVEY.md §5: "the checkpoint bundles *model + tokenizer + fitted
+preprocessor*, which is what makes train→tune→predict→serve composable."
+Parity surface: ``Checkpoint.from_dict/to_dict``
+(Scaling_batch_inference.ipynb:cc-73,76), ``from_directory/to_directory``,
+typed accessors ``get_model/get_tokenizer/get_preprocessor``
+(predictor.py:63-70), ``from_model`` (cc-83), and dtype/placement-morphing
+load (fp16/`device_map="auto"` analog: ``get_params(dtype=..., sharding=...)``,
+Model_finetuning…ipynb:cc-64).
+
+Layout on disk (directory checkpoints)::
+
+    checkpoint/
+      kind.json            # {"kind": "jax_model" | "dict" | "sklearn", ...}
+      model_config.json    # T5Config etc.
+      params.msgpack       # flax param tree (fp32)
+      tokenizer/           # tokenizer assets
+      preprocessor.pkl     # fitted preprocessor (cloudpickle)
+      metrics.json
+      extras.pkl           # anything else (e.g. sklearn model blob)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+import cloudpickle
+import numpy as np
+
+
+def _params_to_msgpack(params) -> bytes:
+    from flax import serialization
+
+    return serialization.msgpack_serialize(
+        __import__("jax").tree_util.tree_map(np.asarray, params)
+    )
+
+
+def _params_from_msgpack(blob: bytes):
+    from flax import serialization
+
+    return serialization.msgpack_restore(blob)
+
+
+class Checkpoint:
+    """A directory- or dict-backed immutable training artifact."""
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None, path: Optional[str] = None):
+        if (data is None) == (path is None):
+            raise ValueError("provide exactly one of data= or path=")
+        self._data = data
+        self._path = path
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path=path)
+
+    @classmethod
+    def from_model(
+        cls,
+        model_config=None,
+        params=None,
+        tokenizer=None,
+        preprocessor=None,
+        metrics: Optional[Dict[str, Any]] = None,
+        path: Optional[str] = None,
+        extras: Optional[Dict[str, Any]] = None,
+    ) -> "Checkpoint":
+        """Bundle a jax model (+tokenizer+preprocessor) into a directory
+        checkpoint (HuggingFaceCheckpoint.from_model analog, cc-83)."""
+        path = path or tempfile.mkdtemp(prefix="tpu_air-ckpt-")
+        os.makedirs(path, exist_ok=True)
+        kind = {"kind": "jax_model"}
+        with open(os.path.join(path, "kind.json"), "w") as f:
+            json.dump(kind, f)
+        if model_config is not None:
+            with open(os.path.join(path, "model_config.json"), "w") as f:
+                f.write(
+                    model_config.to_json()
+                    if hasattr(model_config, "to_json")
+                    else json.dumps(model_config)
+                )
+        if params is not None:
+            with open(os.path.join(path, "params.msgpack"), "wb") as f:
+                f.write(_params_to_msgpack(params))
+        if tokenizer is not None:
+            tokenizer.save_pretrained(os.path.join(path, "tokenizer"))
+        if preprocessor is not None:
+            with open(os.path.join(path, "preprocessor.pkl"), "wb") as f:
+                cloudpickle.dump(preprocessor, f)
+        if metrics:
+            with open(os.path.join(path, "metrics.json"), "w") as f:
+                json.dump(metrics, f, default=float)
+        if extras:
+            with open(os.path.join(path, "extras.pkl"), "wb") as f:
+                cloudpickle.dump(extras, f)
+        return cls(path=path)
+
+    # -- dict/directory interop -------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        out: Dict[str, Any] = {}
+        for name, loader in (
+            ("model_config", self._load_model_config),
+            ("params", self.get_params),
+            ("preprocessor", self.get_preprocessor),
+            ("metrics", self.get_metrics),
+            ("extras", self._load_extras),
+        ):
+            try:
+                v = loader()
+            except (FileNotFoundError, KeyError):
+                v = None
+            if v is not None:
+                out[name] = v
+        return out
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if self._path is not None:
+            if path and os.path.abspath(path) != os.path.abspath(self._path):
+                shutil.copytree(self._path, path, dirs_exist_ok=True)
+                return path
+            return self._path
+        path = path or tempfile.mkdtemp(prefix="tpu_air-ckpt-")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "kind.json"), "w") as f:
+            json.dump({"kind": "dict"}, f)
+        with open(os.path.join(path, "data.pkl"), "wb") as f:
+            cloudpickle.dump(self._data, f)
+        return path
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def _dir_file(self, name: str) -> str:
+        if self._path is None:
+            raise KeyError(name)
+        p = os.path.join(self._path, name)
+        if not os.path.exists(p):
+            raise FileNotFoundError(p)
+        return p
+
+    def _dict_backed(self) -> Optional[Dict[str, Any]]:
+        if self._data is not None:
+            return self._data
+        try:
+            with open(self._dir_file("data.pkl"), "rb") as f:
+                return cloudpickle.load(f)
+        except (FileNotFoundError, KeyError):
+            return None
+
+    # -- typed accessors (predictor.py:63-70 parity) ------------------------
+    def _load_model_config(self):
+        data = self._data or {}
+        if "model_config" in data:
+            return data["model_config"]
+        with open(self._dir_file("model_config.json")) as f:
+            raw = f.read()
+        from tpu_air.models.t5 import T5Config
+
+        d = json.loads(raw)
+        return T5Config.from_dict(d)
+
+    def get_params(self, dtype: Optional[str] = None, sharding=None):
+        """Load the param tree, optionally morphing dtype/placement at load
+        time (the fp16/device_map analog, cc-64)."""
+        if self._data is not None:
+            params = self._data.get("params")
+        else:
+            with open(self._dir_file("params.msgpack"), "rb") as f:
+                params = _params_from_msgpack(f.read())
+        if params is None:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        def conv(x):
+            arr = jnp.asarray(x, dtype=jnp.dtype(dtype) if dtype else None)
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            return arr
+
+        return jax.tree_util.tree_map(conv, params)
+
+    def get_model(self, model_cls=None, dtype: Optional[str] = None, sharding=None):
+        """Rebuild the model.  For jax checkpoints returns ``(model, params)``;
+        for sklearn-backed checkpoints returns the estimator.  ``model_cls``
+        defaults by config type — the reference passes the class explicitly
+        (cc-64 model_cls=T5…)."""
+        dd = self._dict_backed()
+        if dd is not None and "model" in dd:
+            return dd["model"]
+        extras = self._load_extras()
+        if isinstance(extras, dict) and "sklearn_model" in extras:
+            return extras["sklearn_model"]
+        config = self._load_model_config()
+        if dtype:
+            config.dtype = dtype
+        if model_cls is None:
+            from tpu_air.models.t5 import T5ForConditionalGeneration
+
+            model_cls = T5ForConditionalGeneration
+        model = model_cls(config)
+        return model, self.get_params(dtype=None, sharding=sharding)
+
+    def get_tokenizer(self, tokenizer_cls=None):
+        dd = self._dict_backed()
+        if dd is not None and "tokenizer" in dd:
+            return dd["tokenizer"]
+        tok_dir = self._dir_file("tokenizer")
+        if tokenizer_cls is not None:
+            return tokenizer_cls.from_pretrained(tok_dir)
+        from tpu_air.models.tokenizer import auto_tokenizer
+
+        return auto_tokenizer(tok_dir)
+
+    def get_preprocessor(self):
+        dd = self._dict_backed()
+        if dd is not None:
+            return dd.get("preprocessor")
+        try:
+            with open(self._dir_file("preprocessor.pkl"), "rb") as f:
+                return cloudpickle.load(f)
+        except (FileNotFoundError, KeyError):
+            return None
+
+    def get_metrics(self) -> Dict[str, Any]:
+        dd = self._dict_backed()
+        if dd is not None:
+            return dd.get("metrics", {})
+        try:
+            with open(self._dir_file("metrics.json")) as f:
+                return json.load(f)
+        except (FileNotFoundError, KeyError):
+            return {}
+
+    def _load_extras(self):
+        dd = self._dict_backed()
+        if dd is not None:
+            return dd.get("extras")
+        try:
+            with open(self._dir_file("extras.pkl"), "rb") as f:
+                return cloudpickle.load(f)
+        except (FileNotFoundError, KeyError):
+            return None
+
+    def __repr__(self):
+        src = self._path if self._path else f"dict[{list((self._data or {}).keys())}]"
+        return f"Checkpoint({src})"

@@ -1,0 +1,72 @@
+"""Typed training configs (tier-1 config surface, SURVEY.md §5 config notes).
+
+Parity targets: ``ScalingConfig(num_workers, use_gpu)``
+(Model_finetuning…ipynb:cc-40) — TPU-native fields added per SURVEY.md §5
+("ScalingConfig gains topology/sub-mesh fields"); ``RunConfig`` /
+``CheckpointConfig(num_to_keep, checkpoint_score_attribute,
+checkpoint_score_order)`` (cc-40); ``FailureConfig`` (§5 failure-detection
+notes — absent in the reference workloads but part of the Train surface).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How much of the slice a run uses.
+
+    ``num_workers`` is the data-parallel degree (per-worker dataset shards,
+    Model_finetuning…ipynb:cc-29).  On TPU a "worker" is a chip in the run's
+    sub-mesh, not a GPU process: the trainer jits ONE SPMD step over a mesh of
+    ``num_workers × num_chips_per_worker`` chips (SURVEY.md §7 stance).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = True
+    num_chips_per_worker: int = 1
+    topology: Optional[str] = None  # e.g. "v4-32"; informational for placement
+    resources_per_worker: Optional[Dict[str, float]] = None
+    # GPU-era alias accepted for drop-in compatibility (cc-40's use_gpu=True)
+    use_gpu: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.use_gpu is not None:
+            self.use_tpu = bool(self.use_gpu)
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_workers * self.num_chips_per_worker if self.use_tpu else 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Score-based checkpoint retention (cc-40: keep best-1 by min
+    eval_loss)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "min"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("min", "max"):
+            raise ValueError("checkpoint_score_order must be 'min' or 'max'")
+
+
+@dataclass
+class FailureConfig:
+    """Retry policy: restart a failed run from its latest checkpoint
+    (SURVEY.md §5: 'trainer restart from latest checkpoint')."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None  # defaults to ~/tpu_air_results
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    verbose: int = 1

@@ -1,0 +1,168 @@
+"""Training session context — the worker↔driver reporting channel.
+
+SURVEY.md §5 metrics notes: "a ``report(metrics, checkpoint)`` primitive from
+workers → driver, pluggable sinks."  The training loop calls
+``session.report`` per epoch; the session records history, applies
+score-based checkpoint retention (CheckpointConfig, cc-40), forwards metrics
+to sinks (tensorboard/prometheus when available), and raises ``StopTrial``
+when a Tune scheduler has pruned the trial (ASHA, cc-51).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .checkpoint import Checkpoint
+from .config import CheckpointConfig
+
+
+class StopTrial(Exception):
+    """Raised inside the training loop when the scheduler stops this trial."""
+
+
+class Session:
+    def __init__(
+        self,
+        run_dir: str,
+        checkpoint_config: Optional[CheckpointConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        config: Optional[Dict[str, Any]] = None,
+        world_size: int = 1,
+        decision_cb: Optional[Callable[[Dict[str, Any]], bool]] = None,
+        sinks: Optional[List] = None,
+    ):
+        self.run_dir = run_dir
+        self.checkpoint_config = checkpoint_config or CheckpointConfig()
+        self.datasets = datasets or {}
+        self.config = config or {}
+        self.world_size = world_size
+        self.decision_cb = decision_cb
+        self.sinks = sinks if sinks is not None else _default_sinks(run_dir)
+        self.history: List[Dict[str, Any]] = []
+        self.checkpoints: List[Tuple[str, Dict[str, Any]]] = []  # (dir, metrics)
+        self._iter = 0
+        os.makedirs(run_dir, exist_ok=True)
+
+    # -- dataset access (train_loop_per_worker surface) --------------------
+    def get_dataset_shard(self, name: str):
+        return self.datasets.get(name)
+
+    # -- reporting ---------------------------------------------------------
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        self._iter += 1
+        rec = dict(metrics)
+        rec.setdefault("training_iteration", self._iter)
+        rec.setdefault("_timestamp", time.time())
+        self.history.append(rec)
+        with open(os.path.join(self.run_dir, "progress.jsonl"), "a") as f:
+            f.write(json.dumps(rec, default=float) + "\n")
+        for sink in self.sinks:
+            try:
+                sink.log(rec, self._iter)
+            except Exception:
+                pass
+        if checkpoint is not None:
+            self._retain(checkpoint, rec)
+        if self.decision_cb is not None and not self.decision_cb(rec):
+            raise StopTrial(f"trial stopped by scheduler at iteration {self._iter}")
+
+    # -- retention (CheckpointConfig semantics, cc-40) ----------------------
+    def _retain(self, checkpoint: Checkpoint, metrics: Dict[str, Any]):
+        import tempfile
+
+        ckpt_dir = os.path.join(self.run_dir, f"checkpoint_{self._iter:06d}")
+        src = checkpoint.path
+        checkpoint.to_directory(ckpt_dir)
+        # from_model() stages into a tempdir; once copied under run_dir the
+        # staging copy would leak one param tree per epoch — remove it and
+        # repoint the handle at the retained copy.
+        if (
+            src
+            and os.path.abspath(src) != os.path.abspath(ckpt_dir)
+            and os.path.abspath(src).startswith(tempfile.gettempdir() + os.sep)
+        ):
+            shutil.rmtree(src, ignore_errors=True)
+            checkpoint._path = ckpt_dir
+        self.checkpoints.append((ckpt_dir, metrics))
+        cfg = self.checkpoint_config
+        if cfg.num_to_keep is None or len(self.checkpoints) <= cfg.num_to_keep:
+            return
+        attr = cfg.checkpoint_score_attribute
+        if attr:
+            sign = 1 if cfg.checkpoint_score_order == "min" else -1
+            ranked = sorted(
+                self.checkpoints,
+                key=lambda cm: sign * float(cm[1].get(attr, float("inf") * sign)),
+            )
+        else:
+            ranked = list(self.checkpoints)  # keep most recent
+            ranked.reverse()
+        keep = ranked[: cfg.num_to_keep]
+        for path, _ in self.checkpoints:
+            if all(path != k[0] for k in keep):
+                shutil.rmtree(path, ignore_errors=True)
+        self.checkpoints = [cm for cm in self.checkpoints if any(cm[0] == k[0] for k in keep)]
+
+    # -- results ------------------------------------------------------------
+    def best_checkpoint(self) -> Optional[Tuple[str, Dict[str, Any]]]:
+        if not self.checkpoints:
+            return None
+        cfg = self.checkpoint_config
+        attr = cfg.checkpoint_score_attribute
+        if not attr:
+            return self.checkpoints[-1]
+        sign = 1 if cfg.checkpoint_score_order == "min" else -1
+        return min(
+            self.checkpoints,
+            key=lambda cm: sign * float(cm[1].get(attr, float("inf") * sign)),
+        )
+
+    def latest_checkpoint(self) -> Optional[Tuple[str, Dict[str, Any]]]:
+        return self.checkpoints[-1] if self.checkpoints else None
+
+
+def _default_sinks(run_dir: str) -> List:
+    sinks = []
+    try:
+        from tpu_air.utils.metrics import TensorboardSink
+
+        sinks.append(TensorboardSink(run_dir))
+    except Exception:
+        pass
+    return sinks
+
+
+# -- module-level session (what user train loops import) ---------------------
+
+_active: Optional[Session] = None
+
+
+def _set_active(s: Optional[Session]):
+    global _active
+    _active = s
+
+
+def get_session() -> Session:
+    if _active is None:
+        raise RuntimeError("no active training session (call inside a trainer loop)")
+    return _active
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+    get_session().report(metrics, checkpoint)
+
+
+def get_dataset_shard(name: str):
+    return get_session().get_dataset_shard(name)
+
+
+def get_config() -> Dict[str, Any]:
+    return get_session().config
+
+
+def get_world_size() -> int:
+    return get_session().world_size

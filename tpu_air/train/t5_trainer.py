@@ -1,0 +1,317 @@
+"""T5Trainer — the flagship fine-tune engine (W1/W5, Model_finetuning…ipynb).
+
+Replaces the reference's per-worker HF ``Trainer`` factory + NCCL DDP
+(trainer_init_per_worker, cc-34; "PyTorch DDP synchronizes their weights",
+cc-29) with one jit-compiled SPMD train step over a ``(data, model)`` mesh:
+
+* batch sharded on ``data`` — per-device shards replace per-worker dataset
+  shards; the gradient all-reduce is the psum XLA emits for replicated
+  params (ICI, not NCCL);
+* optional tensor parallelism via the ``model`` axis (param rules in
+  tpu_air/parallel/sharding.py) — a config change, per SURVEY.md §2C;
+* params donated through the step (no copies), activations in
+  ``model_config.dtype`` (bf16 on TPU — the fp16-on-GPU analog);
+* per-epoch eval / checkpoint / report matching the HF epoch strategies the
+  reference configures (evaluation_strategy/save_strategy/logging_strategy
+  ="epoch", cc-34), metric names ``loss``/``eval_loss`` (cc-40).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .checkpoint import Checkpoint
+from .trainer import BaseTrainer
+
+
+@dataclass
+class TrainingArguments:
+    """The subset of HF TrainingArguments the reference exercises (cc-34),
+    plus TPU-native knobs."""
+
+    learning_rate: float = 2e-5
+    per_device_train_batch_size: int = 2
+    per_device_eval_batch_size: Optional[int] = None
+    num_train_epochs: int = 4
+    weight_decay: float = 0.01
+    warmup_steps: int = 0
+    max_grad_norm: float = 1.0
+    optimizer: str = "adamw"  # or "adafactor"
+    seed: int = 42
+    evaluation_strategy: str = "epoch"
+    save_strategy: str = "epoch"
+    logging_strategy: str = "epoch"
+    max_steps_per_epoch: Optional[int] = None  # test dial
+    tensor_parallelism: int = 1
+    remat: bool = False  # jax.checkpoint the decoder layers (HBM for FLOPs)
+    disable_tqdm: bool = True  # accepted for parity; no tqdm either way
+
+    def __post_init__(self):
+        if self.per_device_eval_batch_size is None:
+            self.per_device_eval_batch_size = self.per_device_train_batch_size
+
+
+def collate(batch_df, keys, seq_len: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """DataFrame of per-row token lists → stacked int32 arrays."""
+    out = {}
+    for k in keys:
+        col = [np.asarray(v, dtype=np.int32) for v in batch_df[k]]
+        out[k] = np.stack(col)
+        if seq_len is not None and out[k].shape[1] != seq_len:
+            raise ValueError(
+                f"column {k} has seq len {out[k].shape[1]}, expected {seq_len}"
+            )
+    return out
+
+
+def _make_optimizer(args: TrainingArguments, total_steps: int):
+    import optax
+
+    if args.warmup_steps > 0:
+        lr = optax.linear_schedule(0.0, args.learning_rate, args.warmup_steps)
+    else:
+        lr = args.learning_rate
+    if args.optimizer == "adafactor":
+        tx = optax.adafactor(learning_rate=lr)
+    else:
+        tx = optax.adamw(
+            learning_rate=lr, weight_decay=args.weight_decay, b1=0.9, b2=0.999
+        )
+    if args.max_grad_norm:
+        tx = optax.chain(optax.clip_by_global_norm(args.max_grad_norm), tx)
+    return tx
+
+
+def t5_train_loop(config: Dict[str, Any]) -> None:
+    """The SPMD training function (runs inside the trial actor, on its chip
+    lease). Uses the session API for data/report."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_air.models.t5 import (
+        T5Config,
+        T5ForConditionalGeneration,
+        cross_entropy_loss,
+        shift_right,
+    )
+    from tpu_air.parallel import make_mesh, visible_devices
+    from tpu_air.parallel.sharding import shard_params, t5_param_shardings
+    from tpu_air.train import session
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    args: TrainingArguments = config.get("training_args") or TrainingArguments(
+        **{
+            k: v
+            for k, v in config.items()
+            if k in TrainingArguments.__dataclass_fields__
+        }
+    )
+    # Tune-style overrides arrive as plain dict keys (cc-34 lines 75-79:
+    # config.get("learning_rate", ...) pattern)
+    for k in ("learning_rate", "num_train_epochs", "weight_decay"):
+        if k in config:
+            setattr(args, k, config[k])
+    if "epochs" in config:
+        args.num_train_epochs = config["epochs"]
+
+    model_config: T5Config = config["model_config"]
+    tokenizer = config.get("tokenizer")
+    preprocessor = config.get("_preprocessor")
+
+    # -- mesh ---------------------------------------------------------------
+    devs = visible_devices()
+    tp = max(1, args.tensor_parallelism)
+    dp = max(1, len(devs) // tp)
+    mesh = make_mesh(("data", "model"), (dp, tp), devices=devs[: dp * tp])
+    ndev = dp * tp
+
+    model = T5ForConditionalGeneration(model_config)
+    pad_id = model_config.pad_token_id
+    start_id = model_config.decoder_start_token_id
+
+    # -- data ---------------------------------------------------------------
+    train_ds = session.get_dataset_shard("train")
+    eval_ds = session.get_dataset_shard("evaluation")
+    if eval_ds is None:
+        eval_ds = session.get_dataset_shard("eval")
+    if train_ds is None:
+        raise ValueError("T5Trainer requires a 'train' dataset")
+    global_bs = args.per_device_train_batch_size * dp
+    keys = ["input_ids", "attention_mask", "labels"]
+
+    # -- params -------------------------------------------------------------
+    sample = next(train_ds.iter_batches(batch_size=2, batch_format="pandas"))
+    sample_batch = collate(sample, keys)
+    seq_len = sample_batch["input_ids"].shape[1]
+
+    resume_dir = config.get("resume_from_checkpoint")
+    pretrained = config.get("pretrained_params")
+    if resume_dir:
+        params = Checkpoint.from_directory(resume_dir).get_params()
+    elif pretrained is not None:
+        params = pretrained
+    else:
+        init_rng = jax.random.PRNGKey(args.seed)
+        dummy = jnp.ones((1, 8), jnp.int32)
+        params = model.init(init_rng, dummy, dummy, dummy[:, :4])["params"]
+
+    n_train = train_ds.count()
+    steps_per_epoch = max(1, n_train // global_bs)
+    if args.max_steps_per_epoch:
+        steps_per_epoch = min(steps_per_epoch, args.max_steps_per_epoch)
+    tx = _make_optimizer(args, steps_per_epoch * args.num_train_epochs)
+
+    param_shardings = t5_param_shardings(params, mesh)
+    params = jax.tree_util.tree_map(jax.device_put, params, param_shardings)
+    opt_state = tx.init(params)
+    batch_sharding = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+
+    # -- steps --------------------------------------------------------------
+    def loss_from_batch(p, batch, dropout_rng):
+        labels = batch["labels"]
+        dec_in = shift_right(labels, start_id, pad_id)
+        dec_mask = (dec_in != pad_id).astype(jnp.int32).at[:, 0].set(1)
+        logits = model.apply(
+            {"params": p},
+            batch["input_ids"],
+            batch["attention_mask"],
+            dec_in,
+            decoder_attention_mask=dec_mask,
+            deterministic=dropout_rng is None,
+            rngs=None if dropout_rng is None else {"dropout": dropout_rng},
+        )
+        return cross_entropy_loss(logits, labels, pad_id)
+
+    from functools import partial
+
+    import optax
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, o, batch, rng):
+        rng, sub = jax.random.split(rng)
+
+        def lf(pp):
+            loss, _ = loss_from_batch(pp, batch, sub)
+            return loss
+
+        loss, grads = jax.value_and_grad(lf)(p)
+        updates, o = tx.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return p, o, loss, rng
+
+    @jax.jit
+    def eval_step(p, batch):
+        loss, ntok = loss_from_batch(p, batch, None)
+        return loss, ntok
+
+    def put_batch(b):
+        return {k: jax.device_put(jnp.asarray(v), batch_sharding) for k, v in b.items()}
+
+    rng = jax.device_put(jax.random.PRNGKey(args.seed + 1), rep)
+
+    # -- epochs -------------------------------------------------------------
+    for epoch in range(int(args.num_train_epochs)):
+        t0 = time.time()
+        tokens = 0
+        losses = []
+        nsteps = 0
+        for batch_df in train_ds.iter_batches(
+            batch_size=global_bs, batch_format="pandas", drop_last=True
+        ):
+            if len(batch_df) < global_bs:
+                continue
+            batch = put_batch(collate(batch_df, keys, seq_len))
+            params, opt_state, loss, rng = train_step(params, opt_state, batch, rng)
+            losses.append(loss)
+            tokens += global_bs * seq_len
+            nsteps += 1
+            if args.max_steps_per_epoch and nsteps >= args.max_steps_per_epoch:
+                break
+        train_loss = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+        dt = time.time() - t0
+        metrics: Dict[str, Any] = {
+            "epoch": epoch + 1,
+            "loss": train_loss,
+            "steps": nsteps,
+            "train_tokens_per_sec": tokens / dt if dt > 0 else 0.0,
+            "train_tokens_per_sec_per_chip": (tokens / dt / ndev) if dt > 0 else 0.0,
+        }
+
+        if eval_ds is not None and args.evaluation_strategy == "epoch":
+            tot, cnt = 0.0, 0
+            ebs = args.per_device_eval_batch_size * dp
+            for batch_df in eval_ds.iter_batches(
+                batch_size=ebs, batch_format="pandas", drop_last=False
+            ):
+                if len(batch_df) < ebs:  # pad partial batch with pad rows
+                    reps = ebs - len(batch_df)
+                    import pandas as pd
+
+                    pad_rows = pd.concat([batch_df.iloc[-1:]] * reps, ignore_index=True)
+                    for k in keys:
+                        pad_rows[k] = pad_rows[k].map(
+                            lambda v: np.full_like(np.asarray(v), pad_id)
+                        )
+                    batch_df = pd.concat([batch_df, pad_rows], ignore_index=True)
+                loss, ntok = eval_step(params, put_batch(collate(batch_df, keys, seq_len)))
+                tot += float(loss) * int(ntok)
+                cnt += int(ntok)
+            metrics["eval_loss"] = tot / max(cnt, 1)
+
+        ckpt = None
+        if args.save_strategy == "epoch":
+            ckpt = Checkpoint.from_model(
+                model_config=model_config,
+                params=params,
+                tokenizer=tokenizer,
+                preprocessor=preprocessor,
+                metrics=metrics,
+            )
+        session.report(metrics, checkpoint=ckpt)
+
+
+class T5Trainer(BaseTrainer):
+    """Drop-in for the reference's HuggingFaceTrainer-on-T5 configuration
+    (Model_finetuning…ipynb:cc-40; flan-t5-batch-inference.py:96-111)."""
+
+    _name_prefix = "T5Trainer"
+
+    def __init__(
+        self,
+        *,
+        model_config=None,
+        model_name: Optional[str] = None,
+        training_args: Optional[TrainingArguments] = None,
+        tokenizer=None,
+        pretrained_params=None,
+        trainer_init_config: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        if model_config is None:
+            from tpu_air.models.t5 import T5Config
+
+            model_config = T5Config.from_name(model_name or "flan-t5-base")
+        self.model_config = model_config
+        self.training_args = training_args or TrainingArguments()
+        self.tokenizer = tokenizer
+        self.pretrained_params = pretrained_params
+        self.trainer_init_config = trainer_init_config or {}
+
+    def _training_fn(self):
+        return t5_train_loop
+
+    def _train_loop_config(self) -> Dict[str, Any]:
+        cfg = dict(self.trainer_init_config)
+        cfg["model_config"] = self.model_config
+        cfg["training_args"] = self.training_args
+        cfg["tokenizer"] = self.tokenizer
+        if self.pretrained_params is not None:
+            cfg["pretrained_params"] = self.pretrained_params
+        return cfg

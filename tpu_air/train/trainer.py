@@ -1,0 +1,253 @@
+"""Trainers: single-controller SPMD training runs (L3).
+
+The reference's Ray Train runs a trainable actor which spawns a WorkerGroup of
+N one-GPU processes coordinated by NCCL DDP (SURVEY.md §3.1).  TPU-native
+design (§7 architecture stance): the worker group collapses into **one
+process holding a chip lease** that jits a single SPMD step over a
+``data``-axis mesh — gradient sync is a compiler-emitted psum over ICI, not a
+runtime service.  What remains of the reference shape:
+
+* the run executes in a dedicated **trial actor** (failure isolation, the
+  driver stays responsive, Tune can run many concurrently on disjoint
+  sub-meshes);
+* per-worker dataset shards (cc-29) become per-device shards of the batch
+  axis, handled inside the jitted step;
+* ``trainer.fit() -> Result`` with metrics/checkpoint/error
+  (Introduction…ipynb:cc-36), retries from the latest checkpoint up to
+  ``FailureConfig.max_failures`` (§5 failure notes), and
+  ``resume_from_checkpoint`` (Introduction…ipynb:cc-33).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+import tpu_air
+from tpu_air.core import remote as _remote_mod
+
+from .checkpoint import Checkpoint
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .result import Result
+from .session import Session, StopTrial, _set_active
+
+
+def _default_storage() -> str:
+    return os.environ.get(
+        "TPU_AIR_RESULTS_DIR", os.path.join(os.path.expanduser("~"), "tpu_air_results")
+    )
+
+
+@tpu_air.remote
+class _TrialRunner:
+    """Actor hosting one training run on its chip lease."""
+
+    def __init__(self):
+        pass
+
+    def run(
+        self,
+        training_fn: Callable[[Dict[str, Any]], None],
+        config: Dict[str, Any],
+        run_dir: str,
+        datasets: Dict[str, Any],
+        checkpoint_config: CheckpointConfig,
+        world_size: int,
+        trial_id: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        decision_cb = None
+        if trial_id is not None:
+            from tpu_air.core import runtime as _rt
+
+            store = _rt.current_worker().store if _rt.current_worker() else None
+
+            def decision_cb(rec, _store=store, _tid=trial_id):
+                # stream the report to the driver (Tune watches these), then
+                # check for an async stop marker (ASHA prune).
+                it = rec.get("training_iteration", 0)
+                _store.put(rec, f"{_tid}-report-{it}")
+                return not _store.contains(f"{_tid}-stop")
+
+        session = Session(
+            run_dir=run_dir,
+            checkpoint_config=checkpoint_config,
+            datasets=datasets,
+            config=config,
+            world_size=world_size,
+            decision_cb=decision_cb,
+        )
+        _set_active(session)
+        out: Dict[str, Any] = {"error": None, "stopped": False}
+        try:
+            training_fn(config)
+        except StopTrial:
+            out["stopped"] = True
+        except BaseException as e:  # noqa: BLE001 - trial boundary
+            out["error"] = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+        finally:
+            _set_active(None)
+            for sink in session.sinks:
+                if hasattr(sink, "close"):
+                    sink.close()
+        out["history"] = session.history
+        out["checkpoints"] = [(p, m) for p, m in session.checkpoints]
+        best = session.best_checkpoint()
+        out["best_checkpoint"] = best
+        out["latest_checkpoint"] = session.latest_checkpoint()
+        return out
+
+
+class BaseTrainer:
+    """Shared fit() machinery.  Subclasses provide ``_training_fn()`` (a
+    picklable function of one ``config`` dict that uses the session API)."""
+
+    _name_prefix = "Trainer"
+
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        preprocessor=None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.preprocessor = preprocessor
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.metadata = metadata or {}
+
+    # -- subclass surface ---------------------------------------------------
+    def _training_fn(self) -> Callable[[Dict[str, Any]], None]:
+        raise NotImplementedError
+
+    def _train_loop_config(self) -> Dict[str, Any]:
+        return {}
+
+    # -- preprocessing (fit-on-train, §1-L2 persistent preprocessor) --------
+    def _preprocess(self) -> Dict[str, Any]:
+        datasets = dict(self.datasets)
+        if self.preprocessor is not None:
+            train = datasets.get("train")
+            if train is not None and self.preprocessor._is_fittable:
+                if not self.preprocessor.check_is_fitted():
+                    self.preprocessor.fit(train)
+            for k, ds in list(datasets.items()):
+                datasets[k] = self.preprocessor.transform(ds)
+        return datasets
+
+    def fit(self) -> Result:
+        tpu_air.init()
+        name = self.run_config.name or (
+            f"{self._name_prefix}_{int(time.time())}_{os.urandom(3).hex()}"
+        )
+        run_dir = os.path.join(
+            self.run_config.storage_path or _default_storage(), name
+        )
+        os.makedirs(run_dir, exist_ok=True)
+        datasets = self._preprocess()
+        return self._run_attempts(datasets, run_dir, trial_id=None)
+
+    # -- attempt loop (failure recovery) ------------------------------------
+    def _run_attempts(
+        self,
+        datasets: Dict[str, Any],
+        run_dir: str,
+        trial_id: Optional[str],
+        extra_config: Optional[Dict[str, Any]] = None,
+    ) -> Result:
+        sc = self.scaling_config
+        rc = self.run_config
+        max_failures = rc.failure_config.max_failures
+        resume = self.resume_from_checkpoint
+        config = dict(self._train_loop_config())
+        if extra_config:
+            config.update(extra_config)
+        config["_preprocessor"] = self.preprocessor
+        attempt = 0
+        while True:
+            if resume is not None:
+                config["resume_from_checkpoint"] = (
+                    resume.to_directory() if isinstance(resume, Checkpoint) else resume
+                )
+            runner = _TrialRunner.options(
+                num_chips=sc.total_chips or None, num_cpus=0
+            ).remote()
+            try:
+                out = tpu_air.get(
+                    runner.run.remote(
+                        self._training_fn(),
+                        config,
+                        run_dir,
+                        datasets,
+                        rc.checkpoint_config,
+                        sc.num_workers,
+                        trial_id,
+                    )
+                )
+                err = out.get("error")
+            except tpu_air.RemoteError as e:  # actor crashed outright
+                out = {"history": [], "checkpoints": [], "best_checkpoint": None,
+                       "latest_checkpoint": None}
+                err = str(e)
+            finally:
+                tpu_air.kill(runner)
+
+            if err is None:
+                return self._assemble(out, run_dir, config, None)
+            latest = out.get("latest_checkpoint")
+            if attempt < max_failures:
+                attempt += 1
+                if latest:
+                    resume = Checkpoint.from_directory(latest[0])
+                continue
+            return self._assemble(
+                out, run_dir, config, RuntimeError(err)
+            )
+
+    def _assemble(self, out, run_dir, config, error) -> Result:
+        best = out.get("best_checkpoint")
+        history = out.get("history", [])
+        return Result(
+            metrics=history[-1] if history else {},
+            checkpoint=Checkpoint.from_directory(best[0]) if best else None,
+            error=error,
+            path=run_dir,
+            metrics_history=history,
+            best_checkpoints=[
+                (Checkpoint.from_directory(p), m) for p, m in out.get("checkpoints", [])
+            ],
+            config={k: v for k, v in config.items() if not k.startswith("_")},
+        )
+
+
+class JaxTrainer(BaseTrainer):
+    """Generic function trainer: runs ``train_loop_per_worker(config)`` once
+    as the SPMD controller of the run's sub-mesh.  The loop uses
+    ``tpu_air.train.session`` (report / get_dataset_shard / get_config) —
+    the TorchTrainer(train_loop_per_worker) analog with the WorkerGroup
+    folded into the mesh."""
+
+    _name_prefix = "JaxTrainer"
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[Dict[str, Any]], None],
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+
+    def _training_fn(self):
+        return self.train_loop_per_worker
+
+    def _train_loop_config(self):
+        return dict(self.train_loop_config)

@@ -1,0 +1,5 @@
+"""tpu_air.utils — cross-cutting helpers."""
+
+from .display import get_random_elements
+
+__all__ = ["get_random_elements"]

@@ -1,0 +1,57 @@
+"""Metric sinks (SURVEY.md §5: tensorboardX / prometheus-client pinned in the
+reference stack; here wired as pluggable sinks on the session's report
+stream)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class TensorboardSink:
+    def __init__(self, log_dir: str):
+        from tensorboardX import SummaryWriter
+
+        self.writer = SummaryWriter(log_dir)
+
+    def log(self, metrics: Dict[str, Any], step: int):
+        for k, v in metrics.items():
+            if k.startswith("_"):
+                continue
+            try:
+                self.writer.add_scalar(k, float(v), step)
+            except (TypeError, ValueError):
+                pass
+        self.writer.flush()
+
+    def close(self):
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class PrometheusSink:
+    """Exposes latest metric values as prometheus gauges (scraped via the
+    dashboard's /metrics endpoint)."""
+
+    def __init__(self, namespace: str = "tpu_air"):
+        from prometheus_client import Gauge
+
+        self._gauge_cls = Gauge
+        self.namespace = namespace
+        self.gauges: Dict[str, Any] = {}
+
+    def log(self, metrics: Dict[str, Any], step: int):
+        for k, v in metrics.items():
+            if k.startswith("_"):
+                continue
+            try:
+                fv = float(v)
+            except (TypeError, ValueError):
+                continue
+            name = k.replace("-", "_").replace("/", "_")
+            if name not in self.gauges:
+                self.gauges[name] = self._gauge_cls(
+                    f"{self.namespace}_{name}", f"tpu_air metric {k}"
+                )
+            self.gauges[name].set(fv)
