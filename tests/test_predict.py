@@ -1,0 +1,229 @@
+"""Predict-layer tests — W3 (distributed batch generation,
+Model_finetuning…ipynb:cc-64-69), W7 predictor variants
+(Scaling_batch_inference.ipynb:cc-73-83), W8 GBDT batch predict
+(Introduction_to_Ray_AI_Runtime.ipynb:cc-57-61)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tpu_air.data as tad
+from tpu_air.data.preprocessors import BatchMapper
+from tpu_air.models.tokenizer import ByteTokenizer
+from tpu_air.models.t5 import T5Config
+from tpu_air.predict import (
+    BatchPredictor,
+    GBDTPredictor,
+    JaxPredictor,
+    Predictor,
+    T5GenerativePredictor,
+)
+from tpu_air.train import (
+    Checkpoint,
+    CheckpointConfig,
+    RunConfig,
+    ScalingConfig,
+    T5Trainer,
+    TrainingArguments,
+)
+
+SEQ = 32
+
+
+def tokenize_preprocessor():
+    def preprocess_function(df: pd.DataFrame) -> pd.DataFrame:
+        t = ByteTokenizer(model_max_length=SEQ)
+        enc = t(list(df["instruction"]), max_length=SEQ, padding="max_length",
+                truncation=True, return_tensors="np")
+        return pd.DataFrame(
+            {"input_ids": list(enc["input_ids"]),
+             "attention_mask": list(enc["attention_mask"])}
+        )
+
+    return BatchMapper(preprocess_function, batch_format="pandas", batch_size=4096)
+
+
+@pytest.fixture(scope="module")
+def t5_checkpoint(air):
+    """A small trained T5 checkpoint bundling model+tokenizer+preprocessor."""
+    rows = [{"instruction": f"repeat w{i % 5}", "output": f"w{i % 5}"} for i in range(32)]
+    ds = tad.from_items(rows)
+    train_ds, eval_ds = ds.train_test_split(0.25)
+
+    def full_pp(df: pd.DataFrame) -> pd.DataFrame:
+        t = ByteTokenizer(model_max_length=SEQ)
+        enc = t(list(df["instruction"]), max_length=SEQ, padding="max_length",
+                truncation=True, return_tensors="np")
+        lab = t(list(df["output"]), max_length=SEQ, padding="max_length",
+                truncation=True, return_tensors="np")
+        return pd.DataFrame(
+            {"input_ids": list(enc["input_ids"]),
+             "attention_mask": list(enc["attention_mask"]),
+             "labels": list(lab["input_ids"])}
+        )
+
+    trainer = T5Trainer(
+        model_config=T5Config.tiny(vocab_size=384),
+        training_args=TrainingArguments(
+            learning_rate=3e-3, per_device_train_batch_size=2,
+            num_train_epochs=1, weight_decay=0.0,
+        ),
+        tokenizer=ByteTokenizer(model_max_length=SEQ),
+        scaling_config=ScalingConfig(num_workers=2, num_chips_per_worker=1),
+        datasets={"train": train_ds, "evaluation": eval_ds},
+        run_config=RunConfig(checkpoint_config=CheckpointConfig(num_to_keep=1)),
+        preprocessor=BatchMapper(full_pp, batch_format="pandas", batch_size=4096),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    return result.checkpoint
+
+
+# -- Predictor base contract -------------------------------------------------
+
+class _PandasDoubler(Predictor):
+    @classmethod
+    def from_checkpoint(cls, checkpoint, **kw):
+        return cls(checkpoint.get_preprocessor())
+
+    def _predict_pandas(self, df, **kw):
+        return pd.DataFrame({"predictions": df["x"] * 2})
+
+
+def test_predictor_dispatch_and_preprocessor():
+    class AddOne:
+        def transform_batch(self, batch):
+            return pd.DataFrame({"x": batch["x"] + 1})
+
+    p = _PandasDoubler(AddOne())
+    out = p.predict(pd.DataFrame({"x": [1, 2, 3]}))
+    assert list(out["predictions"]) == [4, 6, 8]
+
+
+def test_predictor_numpy_batch_conversion():
+    class NumpySum(Predictor):
+        def _predict_numpy(self, data, **kw):
+            return pd.DataFrame({"s": data["x"].sum(axis=-1)})
+
+    out = NumpySum().predict(pd.DataFrame({"x": [[1, 2], [3, 4]]}))
+    assert list(out["s"]) == [3, 7]
+
+
+# -- W3: batch generation ----------------------------------------------------
+
+def test_t5_generative_predictor_single(t5_checkpoint):
+    p = T5GenerativePredictor.from_checkpoint(
+        t5_checkpoint, tokenizer=ByteTokenizer, dtype="bfloat16"
+    )
+    out = p.predict(pd.DataFrame({"instruction": ["repeat w3"], "output": [""]}),
+                    feature_columns=["input_ids", "attention_mask"],
+                    max_new_tokens=4)
+    assert list(out.columns) == ["generated_output"]
+    assert len(out) == 1 and isinstance(out["generated_output"][0], str)
+
+
+def test_batch_predictor_w3(air, t5_checkpoint):
+    """The W3 call shape: BatchPredictor.from_checkpoint → .predict(dataset)."""
+    bp = BatchPredictor.from_checkpoint(
+        t5_checkpoint, T5GenerativePredictor, tokenizer=ByteTokenizer
+    )
+    ds = tad.from_items([{"instruction": f"repeat w{i % 5}", "output": ""}
+                         for i in range(8)])
+    preds = bp.predict(
+        ds,
+        feature_columns=["input_ids", "attention_mask"],
+        batch_size=4,
+        min_scoring_workers=1,
+        max_scoring_workers=2,
+        num_chips_per_worker=1,
+        max_new_tokens=4,
+    )
+    df = preds.to_pandas()
+    assert len(df) == 8
+    assert "generated_output" in df.columns
+    assert all(isinstance(s, str) for s in df["generated_output"])
+
+
+def test_batch_predictor_keep_columns(air, t5_checkpoint):
+    bp = BatchPredictor.from_checkpoint(
+        t5_checkpoint, T5GenerativePredictor, tokenizer=ByteTokenizer
+    )
+    ds = tad.from_items([{"instruction": "repeat w1", "output": "", "idx": i}
+                         for i in range(4)])
+    df = bp.predict(ds, feature_columns=["input_ids", "attention_mask"],
+                    keep_columns=["idx"], batch_size=2,
+                    max_new_tokens=2).to_pandas()
+    assert sorted(df["idx"]) == [0, 1, 2, 3]
+
+
+# -- W7: from_dict checkpoint + custom pandas predictor ----------------------
+
+def test_predictor_from_dict_checkpoint(air):
+    """Scaling_batch_inference.ipynb:cc-73,76 — Checkpoint.from_dict carrying a
+    model object into a custom Predictor."""
+
+    class Scaler(Predictor):
+        def __init__(self, k, preprocessor=None):
+            super().__init__(preprocessor)
+            self.k = k
+
+        @classmethod
+        def from_checkpoint(cls, ckpt, **kw):
+            return cls(ckpt.to_dict()["model"])
+
+        def _predict_pandas(self, df, **kw):
+            return pd.DataFrame({"predictions": df["x"] * self.k})
+
+    ckpt = Checkpoint.from_dict({"model": 3})
+    bp = BatchPredictor.from_checkpoint(ckpt, Scaler)
+    ds = tad.from_items([{"x": i} for i in range(6)])
+    df = bp.predict(ds, batch_size=3).to_pandas()
+    assert sorted(df["predictions"]) == [0, 3, 6, 9, 12, 15]
+
+
+# -- W8: GBDT predict --------------------------------------------------------
+
+def test_gbdt_predictor(air):
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 3)
+    y = (X[:, 0] > 0).astype(int)
+    model = GradientBoostingClassifier(n_estimators=5).fit(X, y)
+    ckpt = Checkpoint.from_model(extras={"sklearn_model": model})
+    bp = BatchPredictor.from_checkpoint(ckpt, GBDTPredictor)
+    ds = tad.from_items([{"a": float(a), "b": float(b), "c": float(c)}
+                         for a, b, c in X[:10]])
+    df = bp.predict(ds, batch_size=5).to_pandas()
+    assert len(df) == 10
+    assert df["predictions"].between(0, 1).all()
+
+
+# -- JaxPredictor ------------------------------------------------------------
+
+def test_jax_predictor(air):
+    import jax.numpy as jnp
+
+    ckpt = Checkpoint.from_dict({"params": {"w": np.array([2.0, 1.0, 0.5])}})
+
+    def apply_fn(params, **feats):
+        x = jnp.stack([jnp.asarray(feats[k], dtype=jnp.float32)
+                       for k in sorted(feats)], axis=-1)
+        return x @ params["w"]
+
+    p = JaxPredictor.from_checkpoint(ckpt, apply_fn=apply_fn)
+    out = p.predict(pd.DataFrame({"a": [1.0, 2.0], "b": [0.0, 1.0], "c": [2.0, 0.0]}))
+    assert np.allclose(out["predictions"], [3.0, 5.0])
+
+
+def test_dict_checkpoint_directory_roundtrip(air):
+    """Regression: dict-backed checkpoint serialized via to_directory() must
+    restore params/model_config through the data.pkl fallback."""
+    cfg = T5Config.tiny(vocab_size=64)
+    params = {"w": np.ones((2, 2), np.float32)}
+    ckpt = Checkpoint.from_dict({"params": params, "model_config": cfg})
+    path = ckpt.to_directory()
+    back = Checkpoint.from_directory(path)
+    assert np.allclose(np.asarray(back.get_params()["w"]), 1.0)
+    d = back.to_dict()
+    assert d["model_config"].d_model == cfg.d_model

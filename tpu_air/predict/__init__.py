@@ -1,0 +1,23 @@
+"""tpu_air.predict — batch/offline inference layer (SURVEY.md §1-L5).
+
+``Predictor`` (+ built-ins) and ``BatchPredictor`` over the Dataset/actor-pool
+substrate.  See reference call stack §3.3.
+"""
+
+from tpu_air.predict.batch_predictor import BatchPredictor
+from tpu_air.predict.predictor import Predictor
+from tpu_air.predict.predictors import (
+    GBDTPredictor,
+    JaxPredictor,
+    SklearnPredictor,
+    T5GenerativePredictor,
+)
+
+__all__ = [
+    "BatchPredictor",
+    "Predictor",
+    "GBDTPredictor",
+    "JaxPredictor",
+    "SklearnPredictor",
+    "T5GenerativePredictor",
+]

@@ -1,0 +1,146 @@
+"""Built-in predictors.
+
+* ``T5GenerativePredictor`` — the generative-inference predictor of the
+  primary workload (the ``HuggingFaceModelPredictor`` analog, reference
+  predictor.py:14-106): pulls model/tokenizer/preprocessor from a Checkpoint,
+  runs the jit-compiled autoregressive ``generate`` on device, decodes to a
+  ``generated_output`` column.  TPU-first: inputs go through a single
+  host→HBM transfer, decode runs as a compiled ``lax.scan`` with a KV cache
+  (no per-token Python), and dtype morphing (bf16) happens at param load.
+* ``JaxPredictor`` — generic forward-pass predictor for any Flax model
+  (``TorchPredictor`` analog, Scaling_batch_inference.ipynb:cc-71).
+* ``GBDTPredictor`` — the ``XGBoostPredictor`` analog
+  (Introduction_to_Ray_AI_Runtime.ipynb:cc-57) over the host-side sklearn
+  gradient-boosting model produced by ``GBDTTrainer``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from tpu_air.predict.predictor import Predictor
+
+
+class T5GenerativePredictor(Predictor):
+    """Batched text generation from a T5 checkpoint (predictor.py:14-106 analog)."""
+
+    def __init__(self, model, params, tokenizer=None, preprocessor=None):
+        super().__init__(preprocessor)
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint,
+        *,
+        model_cls=None,
+        tokenizer=None,
+        dtype: Optional[str] = None,
+        sharding=None,
+        use_tpu: bool = True,
+        **_: Any,
+    ) -> "T5GenerativePredictor":
+        """Build from a Checkpoint.  ``dtype="bfloat16"`` is the TPU analog of
+        the reference's fp16 load (Model_finetuning…ipynb:cc-64); ``sharding``
+        is the ``device_map="auto"`` analog — an explicit jax.sharding spec."""
+        model, params = checkpoint.get_model(model_cls=model_cls, dtype=dtype, sharding=sharding)
+        if dtype:
+            import jax
+            import jax.numpy as jnp
+
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.dtype(dtype)) if hasattr(x, "astype") else x, params
+            )
+        tok = tokenizer
+        if tok is None or isinstance(tok, type):
+            loaded = checkpoint.get_tokenizer(tok if isinstance(tok, type) else None)
+            tok = loaded
+        return cls(model, params, tok, checkpoint.get_preprocessor())
+
+    def _predict_numpy(
+        self,
+        data: Dict[str, np.ndarray],
+        feature_columns: Optional[List[str]] = None,
+        max_new_tokens: int = 128,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        **_: Any,
+    ) -> pd.DataFrame:
+        from tpu_air.models.t5.generate import generate
+
+        if feature_columns:
+            data = {k: v for k, v in data.items() if k in feature_columns}
+        input_ids = np.asarray(data["input_ids"])
+        mask = data.get("attention_mask")
+        seqs = generate(
+            self.model,
+            self.params,
+            input_ids,
+            attention_mask=mask,
+            max_new_tokens=max_new_tokens,
+            do_sample=do_sample,
+            temperature=temperature,
+            top_k=top_k,
+        )
+        seqs = np.asarray(seqs)
+        if self.tokenizer is not None:
+            texts = self.tokenizer.batch_decode(seqs, skip_special_tokens=True)
+        else:
+            texts = [" ".join(map(str, row)) for row in seqs]
+        return pd.DataFrame({"generated_output": texts})
+
+
+class JaxPredictor(Predictor):
+    """Generic forward-pass predictor: ``apply_fn(params, **features)``."""
+
+    def __init__(self, apply_fn: Callable, params, preprocessor=None, output_column: str = "predictions"):
+        super().__init__(preprocessor)
+        self.apply_fn = apply_fn
+        self.params = params
+        self.output_column = output_column
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint, *, apply_fn: Callable, dtype=None, **_: Any) -> "JaxPredictor":
+        params = checkpoint.get_params(dtype=dtype)
+        return cls(apply_fn, params, checkpoint.get_preprocessor())
+
+    def _predict_numpy(self, data: Dict[str, np.ndarray], **kwargs) -> pd.DataFrame:
+        out = self.apply_fn(self.params, **data, **kwargs)
+        out = np.asarray(out)
+        if out.ndim > 1 and out.shape[-1] == 1:
+            out = out[..., 0]
+        col = list(out) if out.ndim > 1 else out
+        return pd.DataFrame({self.output_column: col})
+
+
+class GBDTPredictor(Predictor):
+    """XGBoostPredictor analog: host-side GBDT scoring (Introduction…ipynb:cc-57)."""
+
+    def __init__(self, model, preprocessor=None):
+        super().__init__(preprocessor)
+        self.model = model
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint, **_: Any) -> "GBDTPredictor":
+        model = checkpoint.get_model()
+        if isinstance(model, tuple):  # (flax_model, params) — wrong checkpoint kind
+            raise TypeError("checkpoint does not contain a GBDT/sklearn model")
+        return cls(model, checkpoint.get_preprocessor())
+
+    def _predict_pandas(self, data: pd.DataFrame, **_: Any) -> pd.DataFrame:
+        X = data.to_numpy(dtype=np.float32)
+        if hasattr(self.model, "predict_proba"):
+            preds = self.model.predict_proba(X)[:, 1]
+        else:
+            preds = self.model.predict(X)
+        return pd.DataFrame({"predictions": preds})
+
+
+class SklearnPredictor(GBDTPredictor):
+    """Alias family for generic sklearn estimators stored in checkpoints."""

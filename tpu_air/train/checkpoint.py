@@ -163,9 +163,9 @@ class Checkpoint:
 
     # -- typed accessors (predictor.py:63-70 parity) ------------------------
     def _load_model_config(self):
-        data = self._data or {}
-        if "model_config" in data:
-            return data["model_config"]
+        dd = self._dict_backed()
+        if dd is not None and "model_config" in dd:
+            return dd["model_config"]
         with open(self._dir_file("model_config.json")) as f:
             raw = f.read()
         from tpu_air.models.t5 import T5Config
@@ -179,8 +179,13 @@ class Checkpoint:
         if self._data is not None:
             params = self._data.get("params")
         else:
-            with open(self._dir_file("params.msgpack"), "rb") as f:
-                params = _params_from_msgpack(f.read())
+            try:
+                with open(self._dir_file("params.msgpack"), "rb") as f:
+                    params = _params_from_msgpack(f.read())
+            except (FileNotFoundError, KeyError):
+                # dict checkpoint serialized via to_directory() → data.pkl
+                dd = self._dict_backed()
+                params = dd.get("params") if dd else None
         if params is None:
             return None
         import jax

@@ -82,22 +82,20 @@ def gbdt_train_loop(config: Dict[str, Any]) -> None:
     # per-round metric stream (staged predictions) → report like xgboost's
     # per-iteration eval (lets ASHA prune on boosting rounds)
     if is_classif:
+        import itertools
+
         stages = enumerate(model.staged_predict_proba(X), start=1)
-        vstages = (
-            dict(enumerate(model.staged_predict_proba(Xv), start=1))
-            if Xv is not None
-            else {}
-        )
+        vals = model.staged_predict_proba(Xv) if Xv is not None else itertools.repeat(None)
         last = None
-        for i, proba in stages:
+        for (i, proba), vproba in zip(stages, vals):
             p = proba[:, 1]
             metrics = {
                 "train-logloss": _logloss(y, p),
                 "train-error": float(np.mean((p > 0.5) != y)),
                 "iteration": i,
             }
-            if i in vstages:
-                pv = vstages[i][:, 1]
+            if vproba is not None:
+                pv = vproba[:, 1]
                 metrics["valid-error"] = float(np.mean((pv > 0.5) != yv))
                 metrics["valid-logloss"] = _logloss(yv, pv)
             last = metrics

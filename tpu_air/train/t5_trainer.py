@@ -42,6 +42,7 @@ class TrainingArguments:
     warmup_steps: int = 0
     max_grad_norm: float = 1.0
     optimizer: str = "adamw"  # or "adafactor"
+    lr_scheduler_type: str = "constant"  # or "linear" / "cosine" decay to 0
     seed: int = 42
     evaluation_strategy: str = "epoch"
     save_strategy: str = "epoch"
@@ -72,10 +73,20 @@ def collate(batch_df, keys, seq_len: Optional[int] = None) -> Dict[str, np.ndarr
 def _make_optimizer(args: TrainingArguments, total_steps: int):
     import optax
 
-    if args.warmup_steps > 0:
-        lr = optax.linear_schedule(0.0, args.learning_rate, args.warmup_steps)
+    decay_steps = max(1, total_steps - args.warmup_steps)
+    if args.lr_scheduler_type == "linear":
+        decay = optax.linear_schedule(args.learning_rate, 0.0, decay_steps)
+    elif args.lr_scheduler_type == "cosine":
+        decay = optax.cosine_decay_schedule(args.learning_rate, decay_steps)
     else:
-        lr = args.learning_rate
+        decay = optax.constant_schedule(args.learning_rate)
+    if args.warmup_steps > 0:
+        lr = optax.join_schedules(
+            [optax.linear_schedule(0.0, args.learning_rate, args.warmup_steps), decay],
+            [args.warmup_steps],
+        )
+    else:
+        lr = decay
     if args.optimizer == "adafactor":
         tx = optax.adafactor(learning_rate=lr)
     else:
@@ -100,7 +111,7 @@ def t5_train_loop(config: Dict[str, Any]) -> None:
         shift_right,
     )
     from tpu_air.parallel import make_mesh, visible_devices
-    from tpu_air.parallel.sharding import shard_params, t5_param_shardings
+    from tpu_air.parallel.sharding import shard_params
     from tpu_air.train import session
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -166,8 +177,7 @@ def t5_train_loop(config: Dict[str, Any]) -> None:
         steps_per_epoch = min(steps_per_epoch, args.max_steps_per_epoch)
     tx = _make_optimizer(args, steps_per_epoch * args.num_train_epochs)
 
-    param_shardings = t5_param_shardings(params, mesh)
-    params = jax.tree_util.tree_map(jax.device_put, params, param_shardings)
+    params = shard_params(params, mesh)
     opt_state = tx.init(params)
     batch_sharding = NamedSharding(mesh, P("data"))
     rep = NamedSharding(mesh, P())

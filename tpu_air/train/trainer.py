@@ -26,7 +26,6 @@ import traceback
 from typing import Any, Callable, Dict, Optional
 
 import tpu_air
-from tpu_air.core import remote as _remote_mod
 
 from .checkpoint import Checkpoint
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
