@@ -1,0 +1,319 @@
+"""Tune-layer tests — W2 (HPO sweep over T5Trainer, 4 trials, ASHA,
+Model_finetuning…ipynb:cc-51-59) and W8 (GBDT tune, 3 samples,
+Introduction_to_Ray_AI_Runtime.ipynb:cc-44-52)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tpu_air.data as tad
+from tpu_air import tune
+from tpu_air.data.preprocessors import BatchMapper
+from tpu_air.models.tokenizer import ByteTokenizer
+from tpu_air.models.t5 import T5Config
+from tpu_air.train import (
+    CheckpointConfig,
+    GBDTTrainer,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    T5Trainer,
+    TrainingArguments,
+    session,
+)
+
+SEQ = 16
+
+
+# -- search space ------------------------------------------------------------
+
+def test_search_space_sampling():
+    rng = np.random.default_rng(0)
+    space = {
+        "lr": tune.choice([1e-3, 1e-2]),
+        "nested": {"wd": tune.uniform(0.0, 1.0), "n": tune.randint(1, 5)},
+        "fixed": "keep",
+    }
+    s = tune.search.sample_space(space, rng)
+    assert s["lr"] in (1e-3, 1e-2)
+    assert 0.0 <= s["nested"]["wd"] < 1.0
+    assert 1 <= s["nested"]["n"] < 5
+    assert s["fixed"] == "keep"
+
+
+def test_grid_search_expansion():
+    space = {"a": tune.grid_search([1, 2]), "b": {"c": tune.grid_search(["x", "y"])}}
+    grids = tune.search.expand_grid(space)
+    combos = {(g["a"], g["b"]["c"]) for g in grids}
+    assert combos == {(1, "x"), (1, "y"), (2, "x"), (2, "y")}
+
+
+def test_loguniform_bounds():
+    rng = np.random.default_rng(1)
+    vals = [tune.loguniform(1e-5, 1e-1).sample(rng) for _ in range(100)]
+    assert all(1e-5 <= v <= 1e-1 for v in vals)
+
+
+# -- ASHA unit ----------------------------------------------------------------
+
+def test_asha_prunes_bad_trial():
+    sched = tune.ASHAScheduler(max_t=8, grace_period=1, reduction_factor=2,
+                               metric="loss", mode="min")
+    # good trial reaches rung 1 first with loss 0.1
+    assert sched.on_result("good", {"training_iteration": 1, "loss": 0.1}) == "CONTINUE"
+    # bad trial hits rung 1 with loss 9 → bottom half → stopped
+    assert sched.on_result("bad", {"training_iteration": 1, "loss": 9.0}) == "STOP"
+    # good continues through rungs, stops at max_t
+    assert sched.on_result("good", {"training_iteration": 2, "loss": 0.05}) == "CONTINUE"
+    assert sched.on_result("good", {"training_iteration": 8, "loss": 0.01}) == "STOP"
+
+
+def test_asha_max_t_budget():
+    sched = tune.ASHAScheduler(max_t=4, metric="m", mode="max")
+    assert sched.on_result("t", {"training_iteration": 4, "m": 1.0}) == "STOP"
+
+
+# -- function trainable sweep -------------------------------------------------
+
+def test_tuner_function_trainable(air):
+    """Concurrent trials with streamed reports and best-result selection."""
+
+    def loop(config):
+        for i in range(3):
+            session.report({"score": config["x"] * (i + 1)})
+
+    tuner = tune.Tuner(
+        loop,
+        param_space={"train_loop_config": {"x": tune.grid_search([1.0, 3.0, 2.0])}},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=1),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 9.0
+    assert best.config["x"] == 3.0
+
+
+def test_tuner_failure_isolation(air):
+    """§5: a failed trial must not kill the sweep (ResultGrid.errors)."""
+
+    def loop(config):
+        if config["x"] == 2:
+            raise ValueError("boom")
+        session.report({"score": float(config["x"])})
+
+    grid = tune.Tuner(
+        loop,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=1),
+    ).fit()
+    assert len(grid) == 3
+    assert grid.num_errors == 1
+    assert "boom" in repr(grid.errors[0])
+    assert grid.get_best_result().metrics["score"] == 3.0
+
+
+def test_tuner_asha_stops_bad_trials(air):
+    """ASHA prune: bad trials stop early, reported iterations < max."""
+
+    def loop(config):
+        import time
+
+        for i in range(6):
+            time.sleep(0.3)  # epochs take time; lets prune markers land
+            session.report({"loss": config["base"] / (i + 1)})
+
+    grid = tune.Tuner(
+        loop,
+        param_space={"base": tune.grid_search([0.1, 100.0, 120.0, 0.2])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=1,
+            scheduler=tune.ASHAScheduler(max_t=6, grace_period=1,
+                                         reduction_factor=2),
+            max_concurrent_trials=2,
+        ),
+    ).fit()
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.config["base"] == 0.1
+    iters = sorted(len(r.metrics_history) for r in grid)
+    assert iters[0] < 6  # at least one trial was pruned early
+
+
+# -- W2: T5 HPO sweep ---------------------------------------------------------
+
+@pytest.mark.slow
+def test_tuner_w2_t5_sweep(air):
+    rows = [{"instruction": f"repeat w{i % 3}", "output": f"w{i % 3}"}
+            for i in range(24)]
+    ds = tad.from_items(rows)
+    train_ds, eval_ds = ds.train_test_split(0.25)
+
+    def pp(df: pd.DataFrame) -> pd.DataFrame:
+        t = ByteTokenizer(model_max_length=SEQ)
+        enc = t(list(df["instruction"]), max_length=SEQ, padding="max_length",
+                truncation=True, return_tensors="np")
+        lab = t(list(df["output"]), max_length=SEQ, padding="max_length",
+                truncation=True, return_tensors="np")
+        return pd.DataFrame({"input_ids": list(enc["input_ids"]),
+                             "attention_mask": list(enc["attention_mask"]),
+                             "labels": list(lab["input_ids"])})
+
+    trainer = T5Trainer(
+        model_config=T5Config.tiny(vocab_size=384),
+        training_args=TrainingArguments(
+            per_device_train_batch_size=2, num_train_epochs=2, weight_decay=0.0,
+        ),
+        tokenizer=ByteTokenizer(model_max_length=SEQ),
+        scaling_config=ScalingConfig(num_workers=1, num_chips_per_worker=1),
+        datasets={"train": train_ds, "evaluation": eval_ds},
+        run_config=RunConfig(checkpoint_config=CheckpointConfig(
+            num_to_keep=1, checkpoint_score_attribute="eval_loss",
+            checkpoint_score_order="min")),
+        preprocessor=BatchMapper(pp, batch_format="pandas", batch_size=4096),
+    )
+    tuner = tune.Tuner(
+        trainer,
+        param_space={"trainer_init_config": {
+            "learning_rate": tune.choice([3e-3, 1e-6]),
+        }},
+        tune_config=tune.TuneConfig(
+            metric="eval_loss", mode="min", num_samples=4, seed=0,
+            scheduler=tune.ASHAScheduler(max_t=4),
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.checkpoint is not None
+    assert best.metrics["eval_loss"] <= min(
+        r.metrics.get("eval_loss", float("inf")) for r in grid if r.error is None
+    )
+    # tuned lr flowed into the trial config
+    assert best.config["learning_rate"] in (3e-3, 1e-6)
+
+
+# -- W8: GBDT sweep -----------------------------------------------------------
+
+def test_tuner_w8_gbdt(air):
+    rng = np.random.RandomState(0)
+    X = rng.randn(96, 3)
+    y = (X[:, 0] + 0.3 * rng.randn(96) > 0).astype(int)
+    rows = [{"a": float(a), "b": float(b), "c": float(c), "label": int(t)}
+            for (a, b, c), t in zip(X, y)]
+    ds = tad.from_items(rows)
+    train_ds, valid_ds = ds.train_test_split(0.25)
+    trainer = GBDTTrainer(
+        label_column="label",
+        params={"objective": "binary:logistic", "max_depth": 3},
+        num_boost_round=5,
+        datasets={"train": train_ds, "valid": valid_ds},
+    )
+    grid = tune.Tuner(
+        trainer,
+        param_space={"params": {
+            "eta": tune.uniform(0.05, 0.3),
+            "max_depth": tune.randint(2, 5),
+        }},
+        tune_config=tune.TuneConfig(metric="valid-logloss", mode="min",
+                                    num_samples=3, seed=7),
+    ).fit()
+    assert len(grid) == 3
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.checkpoint is not None
+    assert 2 <= best.config["params"]["max_depth"] < 5
+
+
+# -- review-driven regressions ------------------------------------------------
+
+def test_grid_times_num_samples(air):
+    """Ray semantics: num_samples multiplies the grid."""
+
+    def loop(config):
+        session.report({"score": float(config["x"])})
+
+    grid = tune.Tuner(
+        loop,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=2),
+    ).fit()
+    assert len(grid) == 4
+    xs = sorted(r.config["x"] for r in grid)
+    assert xs == [1, 1, 2, 2]
+
+
+def test_sample_from_and_plain_callables(air):
+    marker = lambda spec: spec["x"] * 10  # noqa: E731
+
+    def loop(config):
+        assert callable(config["fn"])  # plain callable passed through intact
+        session.report({"score": float(config["y"])})
+
+    grid = tune.Tuner(
+        loop,
+        param_space={"x": tune.grid_search([1, 2]),
+                     "y": tune.sample_from(marker),
+                     "fn": abs},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=1),
+    ).fit()
+    assert grid.num_errors == 0
+    assert sorted(r.config["y"] for r in grid) == [10, 20]
+
+
+def test_trial_retry_on_failure(air, tmp_path):
+    """FailureConfig.max_failures: crashed trials retry (resume from latest)."""
+    from tpu_air.train import FailureConfig
+
+    markers = str(tmp_path)
+
+    def loop(config):
+        import os
+        marker = os.path.join(markers, f"trial-{config['x']}")
+        first = not os.path.exists(marker)
+        if first:
+            open(marker, "w").close()
+        session.report({"score": float(config["x"])})
+        if first and config["x"] == 1:
+            raise ValueError("transient")
+
+    grid = tune.Tuner(
+        loop,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=1),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert grid.num_errors == 0
+    assert len(grid) == 2
+
+
+def test_user_training_iteration_does_not_stall_stream(air):
+    """Reports keyed by internal counter even when user metrics carry their
+    own training_iteration values."""
+    class Recorder(tune.TrialScheduler):
+        def __init__(self):
+            self.seen = []
+
+        def on_result(self, trial_id, metrics):
+            self.seen.append(metrics.get("training_iteration"))
+            return "CONTINUE"
+
+    sched = Recorder()
+
+    def loop(config):
+        import time
+        for step in (100, 200, 300):
+            time.sleep(0.1)
+            session.report({"loss": 1.0 / step, "training_iteration": step})
+
+    grid = tune.Tuner(
+        loop,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min", num_samples=1,
+                                    scheduler=sched),
+    ).fit()
+    assert grid.num_errors == 0
+    # scheduler saw every streamed report despite user-supplied counters
+    assert sched.seen == [100, 200, 300]

@@ -67,7 +67,10 @@ class Session:
                 pass
         if checkpoint is not None:
             self._retain(checkpoint, rec)
-        if self.decision_cb is not None and not self.decision_cb(rec):
+        # pass the internal monotone counter separately: user metrics may
+        # override training_iteration, but report streaming must stay
+        # contiguous (the Tune driver drains report-1, report-2, …)
+        if self.decision_cb is not None and not self.decision_cb(rec, self._iter):
             raise StopTrial(f"trial stopped by scheduler at iteration {self._iter}")
 
     # -- retention (CheckpointConfig semantics, cc-40) ----------------------

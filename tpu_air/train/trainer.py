@@ -62,11 +62,10 @@ class _TrialRunner:
 
             store = _rt.current_worker().store if _rt.current_worker() else None
 
-            def decision_cb(rec, _store=store, _tid=trial_id):
+            def decision_cb(rec, seq, _store=store, _tid=trial_id):
                 # stream the report to the driver (Tune watches these), then
                 # check for an async stop marker (ASHA prune).
-                it = rec.get("training_iteration", 0)
-                _store.put(rec, f"{_tid}-report-{it}")
+                _store.put(rec, f"{_tid}-report-{seq}")
                 return not _store.contains(f"{_tid}-stop")
 
         session = Session(

@@ -1,0 +1,39 @@
+"""tpu_air.tune — trial-parallel hyperparameter optimization (L4).
+
+Reference surface (SURVEY.md §1-L4): ``Tuner``, ``TuneConfig``, search-space
+primitives (``choice``/``uniform``/``randint``/…), ``ASHAScheduler``,
+``ResultGrid``.
+"""
+
+from .result_grid import ResultGrid
+from .schedulers import ASHAScheduler, FIFOScheduler, TrialScheduler
+from .search import (
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .tuner import TuneConfig, Tuner
+
+# reference import spellings: ray.tune.tuner.TuneConfig and
+# ray.tune.schedulers.async_hyperband.ASHAScheduler both resolve here
+from . import schedulers  # noqa: F401
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "ResultGrid",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "sample_from",
+    "uniform",
+]

@@ -1,0 +1,251 @@
+"""Tuner — trial-parallel HPO over a Trainer (L4; SURVEY.md §3.2).
+
+Parity surface: ``Tuner(trainer, param_space, tune_config, run_config)``
+(Model_finetuning…ipynb:cc-57), ``TuneConfig(metric, mode, num_samples,
+scheduler)`` (both import spellings), ``tuner.fit() -> ResultGrid``.
+
+TPU-native resource model (§2C trial parallelism): every trial is a trial
+actor requesting the trainer's ``ScalingConfig`` worth of **chips**; the core
+scheduler queues actors until a chip lease frees, so concurrent trials occupy
+disjoint sub-meshes of the slice and excess trials wait — the reference's
+"1 worker per trial so trials parallelize" dial (cc-53-54) maps to
+``num_chips_per_worker`` sizing the per-trial lease.
+
+Driver loop: trials stream per-epoch reports through the object store
+(`{trial}-report-{i}` keys written by the trial actor's decision callback);
+the scheduler (e.g. ASHA) judges each report and the driver plants a
+`{trial}-stop` marker that the trial's next ``session.report`` observes —
+asynchronous early-stopping with no barrier across trials.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import tpu_air
+from tpu_air.train.checkpoint import Checkpoint
+from tpu_air.train.config import RunConfig
+from tpu_air.train.result import Result
+from tpu_air.train.trainer import BaseTrainer, JaxTrainer, _TrialRunner, _default_storage
+
+from .result_grid import ResultGrid
+from .schedulers import CONTINUE, FIFOScheduler, TrialScheduler
+from .search import expand_grid, sample_space
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    scheduler: Optional[TrialScheduler] = None
+    max_concurrent_trials: Optional[int] = None
+    time_budget_s: Optional[float] = None
+    seed: Optional[int] = None
+    reuse_actors: bool = False  # accepted for parity; actors are per-trial
+
+
+def _deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        if isinstance(trainable, BaseTrainer):
+            self._trainer = trainable
+        elif callable(trainable):
+            # function trainable: config -> session.report(...) calls
+            self._trainer = JaxTrainer(trainable)
+        else:
+            raise TypeError("trainable must be a Trainer or a callable")
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or self._trainer.run_config
+
+    # -- config sampling ----------------------------------------------------
+    def _sample_trial_configs(self) -> List[Dict[str, Any]]:
+        """grid_search axes are exhaustive; num_samples multiplies the grid
+        (Ray semantics: every grid point runs num_samples times)."""
+        tc = self.tune_config
+        rng = np.random.default_rng(tc.seed)
+        subspaces = expand_grid(self.param_space)
+        return [
+            sample_space(space, rng)
+            for _ in range(tc.num_samples)
+            for space in subspaces
+        ]
+
+    def _trial_config(self, sampled: Dict[str, Any]) -> Dict[str, Any]:
+        """Merge a sampled point over the trainer's base config.  The
+        reference nests tuned keys under ``trainer_init_config``
+        (Model_finetuning…ipynb:cc-57) or ``train_loop_config`` — both
+        flatten into the top-level trial config the training fn reads."""
+        base = dict(self._trainer._train_loop_config())
+        sampled = copy.deepcopy(sampled)
+        for alias in ("trainer_init_config", "train_loop_config"):
+            if isinstance(sampled.get(alias), dict):
+                base = _deep_merge(base, sampled.pop(alias))
+        return _deep_merge(base, sampled)
+
+    # -- fit ----------------------------------------------------------------
+    def fit(self) -> ResultGrid:
+        tpu_air.init()
+        from tpu_air.core.runtime import get_runtime
+
+        rt = get_runtime()
+        store = rt.store
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        if tc.metric:
+            scheduler.set_metric(tc.metric, tc.mode)
+
+        name = self.run_config.name or f"Tuner_{int(time.time())}_{os.urandom(3).hex()}"
+        exp_dir = os.path.join(
+            self.run_config.storage_path or _default_storage(), name
+        )
+        os.makedirs(exp_dir, exist_ok=True)
+
+        datasets = self._trainer._preprocess()
+        sc = self._trainer.scaling_config
+        cc = self.run_config.checkpoint_config
+        training_fn = self._trainer._training_fn()
+
+        sampled = self._sample_trial_configs()
+        n = len(sampled)
+        # cap concurrency so trial actors don't exhaust host RAM even when
+        # chips are plentiful; the chip lease queue enforces the mesh limit
+        max_conc = tc.max_concurrent_trials or n
+
+        max_failures = self.run_config.failure_config.max_failures
+
+        trials: List[Dict[str, Any]] = []
+        for i, s in enumerate(sampled):
+            tid = f"{name}_trial_{i:05d}"
+            cfg = self._trial_config(s)
+            cfg["_preprocessor"] = self._trainer.preprocessor
+            if self._trainer.resume_from_checkpoint is not None:
+                resume = self._trainer.resume_from_checkpoint
+                cfg["resume_from_checkpoint"] = (
+                    resume.to_directory() if isinstance(resume, Checkpoint) else resume
+                )
+            trials.append({
+                "id": tid, "config": cfg, "sampled": s,
+                "dir": os.path.join(exp_dir, tid),
+                "runner": None, "future": None, "next_report": 1,
+                "attempt": 0, "start": None,
+            })
+
+        launched = 0
+        running: List[Dict[str, Any]] = []
+        results: List[Optional[Result]] = [None] * n
+        t0 = time.time()
+
+        def budget_left() -> bool:
+            return not (tc.time_budget_s and time.time() - t0 > tc.time_budget_s)
+
+        def launch(tr):
+            os.makedirs(tr["dir"], exist_ok=True)
+            runner = _TrialRunner.options(
+                num_chips=sc.total_chips or None, num_cpus=0
+            ).remote()
+            tr["runner"] = runner
+            tr["start"] = time.time()
+            tr["future"] = runner.run.remote(
+                training_fn, tr["config"], tr["dir"], datasets, cc,
+                sc.num_workers, tr["id"],
+            )
+            running.append(tr)
+
+        def drain_reports(tr):
+            """Feed streamed reports to the scheduler; plant stop markers."""
+            while True:
+                key = f"{tr['id']}-report-{tr['next_report']}"
+                if not store.contains(key):
+                    return
+                rec = store.get(key)
+                store.delete(key)
+                tr["next_report"] += 1
+                if scheduler.on_result(tr["id"], rec) != CONTINUE:
+                    if not store.contains(f"{tr['id']}-stop"):
+                        store.put(True, f"{tr['id']}-stop")
+
+        def finalize(tr, out, err):
+            idx = trials.index(tr)
+            scheduler.on_trial_complete(tr["id"])
+            results[idx] = self._trainer._assemble(
+                out, tr["dir"], tr["config"],
+                RuntimeError(err) if err else None,
+            )
+            tpu_air.kill(tr["runner"])
+            store.delete(f"{tr['id']}-stop")
+            # drop any reports that streamed after the last drain
+            while store.contains(f"{tr['id']}-report-{tr['next_report']}"):
+                store.delete(f"{tr['id']}-report-{tr['next_report']}")
+                tr["next_report"] += 1
+
+        def complete(tr):
+            """Trial future resolved: finalize, or retry per FailureConfig
+            (same resume-from-latest semantics as trainer._run_attempts)."""
+            running.remove(tr)
+            try:
+                out = tpu_air.get(tr["future"])
+                err = out.get("error")
+                if out.get("stopped"):
+                    err = None  # scheduler prune is a clean exit
+            except tpu_air.RemoteError as e:
+                out = {"history": [], "checkpoints": [],
+                       "best_checkpoint": None, "latest_checkpoint": None}
+                err = str(e)
+            drain_reports(tr)
+            if err is not None and tr["attempt"] < max_failures and budget_left():
+                tr["attempt"] += 1
+                tpu_air.kill(tr["runner"])
+                latest = out.get("latest_checkpoint")
+                if latest:
+                    tr["config"]["resume_from_checkpoint"] = latest[0]
+                launch(tr)
+                return
+            finalize(tr, out, err)
+
+        while launched < n and len(running) < max_conc and budget_left():
+            launch(trials[launched])
+            launched += 1
+
+        while running:
+            futures = [tr["future"] for tr in running]
+            ready, _ = tpu_air.wait(futures, num_returns=1, timeout=0.25)
+            for tr in list(running):
+                drain_reports(tr)
+                if tr["future"] in ready:
+                    complete(tr)
+            if not budget_left():
+                # budget exhausted: stop running trials at their next report,
+                # launch nothing further (unlaunched trials are dropped)
+                for tr in running:
+                    if not store.contains(f"{tr['id']}-stop"):
+                        store.put(True, f"{tr['id']}-stop")
+            while launched < n and len(running) < max_conc and budget_left():
+                launch(trials[launched])
+                launched += 1
+
+        return ResultGrid([r for r in results if r is not None],
+                          metric=tc.metric, mode=tc.mode)
