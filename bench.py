@@ -1,0 +1,112 @@
+"""Benchmark harness: FLAN-T5 fine-tune throughput, tokens/sec/chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The reference publishes no comparable number (BASELINE.md — teaching workshop,
+`published: {}`), so vs_baseline is measured against the reference's workshop
+setup contract instead: FLAN-T5 fine-tune with the notebook's hyperparameters
+(per-device batch 2+, seq 512 — Model_finetuning…ipynb:cc-26,32) must sustain
+real training throughput on one chip; vs_baseline reports value / the last
+recorded run when BENCH_LAST.json exists, else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from functools import partial
+
+    from tpu_air.models.t5 import (
+        T5Config,
+        T5ForConditionalGeneration,
+        cross_entropy_loss,
+        shift_right,
+    )
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        config = T5Config.flan_t5_base()
+        batch, enc_len, dec_len = 32, 512, 128
+        steps, warmup = 10, 2
+    else:  # CPU smoke mode — same path, tiny dials (SURVEY.md §4.2)
+        config = T5Config.tiny()
+        batch, enc_len, dec_len = 8, 64, 16
+        steps, warmup = 4, 1
+    config.dropout_rate = 0.0
+    config.dtype = "bfloat16" if on_tpu else "float32"
+
+    model = T5ForConditionalGeneration(config)
+    pad, start = config.pad_token_id, config.decoder_start_token_id
+    rng = jax.random.PRNGKey(0)
+    input_ids = jax.random.randint(rng, (batch, enc_len), 2, config.vocab_size, jnp.int32)
+    attention_mask = jnp.ones((batch, enc_len), jnp.int32)
+    labels = jax.random.randint(rng, (batch, dec_len), 2, config.vocab_size, jnp.int32)
+
+    params = model.init(rng, input_ids[:1, :8], attention_mask[:1, :8], labels[:1, :4])["params"]
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(2e-5, weight_decay=0.01))
+    opt_state = tx.init(params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, o, input_ids, attention_mask, labels):
+        def loss_fn(pp):
+            dec_in = shift_right(labels, start, pad)
+            dec_mask = (dec_in != pad).astype(jnp.int32).at[:, 0].set(1)
+            logits = model.apply(
+                {"params": pp}, input_ids, attention_mask, dec_in,
+                decoder_attention_mask=dec_mask, deterministic=True,
+            )
+            loss, _ = cross_entropy_loss(logits, labels, pad)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    for _ in range(warmup):
+        params, opt_state, loss = train_step(params, opt_state, input_ids, attention_mask, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, input_ids, attention_mask, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * (enc_len + dec_len)
+    value = tokens_per_step * steps / dt
+
+    vs_baseline = 1.0
+    last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST.json")
+    try:
+        with open(last_path) as f:
+            prev = json.load(f)
+        if prev.get("unit") == "tokens/sec/chip" and prev.get("value"):
+            vs_baseline = value / float(prev["value"])
+    except Exception:
+        pass
+
+    result = {
+        "metric": f"flan-t5-{'base' if on_tpu else 'tiny'} fine-tune throughput ({platform})",
+        "value": round(value, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }
+    try:
+        with open(last_path, "w") as f:
+            json.dump(result, f)
+    except Exception:
+        pass
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

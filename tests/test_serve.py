@@ -1,0 +1,129 @@
+"""Serve-layer tests — W8 online serving (Introduction_to_Ray_AI_Runtime
+.ipynb:cc-70-79): deployments, replica load-balancing, HTTP proxy + JSON
+adapter, PredictorDeployment over a Checkpoint."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_air import serve
+from tpu_air.serve import PredictorDeployment, pandas_read_json
+
+PORT = 8123
+
+
+def _post(path, payload, port=PORT):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture(autouse=True)
+def _teardown(air):
+    yield
+    serve.shutdown()
+
+
+def test_deployment_options_and_bind(air):
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return payload
+
+    d = Echo.options(name="echo", num_replicas=3, route_prefix="/echo")
+    assert d.name == "echo" and d.num_replicas == 3
+    app = d.bind()
+    assert app.deployment.route_prefix == "/echo"
+
+
+def test_http_round_trip_json(air):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, payload):
+            return {"doubled": [2 * x for x in payload["values"]]}
+
+    serve.run(
+        Doubler.options(name="doubler", num_replicas=2, route_prefix="/double").bind(),
+        port=PORT,
+    )
+    status, out = _post("/double", {"values": [1, 2, 3]})
+    assert status == 200
+    assert out == {"doubled": [2, 4, 6]}
+
+
+def test_routes_and_404(air):
+    @serve.deployment
+    class Ok:
+        def __call__(self, payload):
+            return "ok"
+
+    serve.run(Ok.options(name="ok", route_prefix="/ok").bind(), port=PORT)
+    status, routes = _post("/-/routes", {})
+    assert status == 200 and "/ok" in routes
+    try:
+        status, _ = _post("/nope", {})
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 404
+
+
+def test_replica_load_balancing(air):
+    import os
+
+    @serve.deployment
+    class WhoAmI:
+        def __init__(self):
+            self.pid = os.getpid()
+
+        def __call__(self, payload):
+            return {"pid": self.pid}
+
+    h = serve.run(
+        WhoAmI.options(name="who", num_replicas=2, route_prefix="/who").bind(),
+        port=PORT,
+    )
+    assert h.num_replicas() == 2
+    pids = {_post("/who", {})[1]["pid"] for _ in range(6)}
+    assert len(pids) == 2  # round-robin reaches both replicas
+
+
+def test_predictor_deployment_over_checkpoint(air):
+    """serve.run(PredictorDeployment...bind(PredictorCls, ckpt,
+    http_adapter=pandas_read_json)) — the cc-71 call shape."""
+    from tpu_air.predict import Predictor
+    from tpu_air.train import Checkpoint
+
+    class LinearPredictor(Predictor):
+        def __init__(self, w, b, preprocessor=None):
+            super().__init__(preprocessor)
+            self.w, self.b = w, b
+
+        @classmethod
+        def from_checkpoint(cls, checkpoint, **kw):
+            d = checkpoint.to_dict()
+            return cls(d["w"], d["b"], preprocessor=checkpoint.get_preprocessor())
+
+        def _predict_pandas(self, df: pd.DataFrame, **kw) -> pd.DataFrame:
+            x = df[["x"]].to_numpy(dtype=float)
+            return pd.DataFrame({"predictions": (x * self.w + self.b).ravel()})
+
+    ckpt = Checkpoint.from_dict({"w": 2.0, "b": 1.0})
+    serve.run(
+        PredictorDeployment.options(
+            name="LinearService", num_replicas=2, route_prefix="/linear"
+        ).bind(LinearPredictor, ckpt, http_adapter=pandas_read_json),
+        port=PORT,
+    )
+    status, out = _post("/linear", [{"x": 1.0}, {"x": 3.0}])
+    assert status == 200
+    assert [r["predictions"] for r in out] == [3.0, 7.0]
+    st = serve.status()
+    assert st["deployments"]["/linear"]["num_replicas"] == 2

@@ -1,0 +1,31 @@
+"""tpu_air.serve — online inference over replica actors + HTTP proxy.
+
+Parity surface (SURVEY.md §1-L5 "Online (serving)", §3.5):
+``serve.run(PredictorDeployment.options(name=..., num_replicas=2,
+route_prefix="/rayair").bind(PredictorCls, checkpoint,
+http_adapter=pandas_read_json))`` and client ``requests.post`` to
+``http://localhost:8000/<route>`` (Introduction_to_Ray_AI_Runtime.ipynb:cc-70-74).
+
+TPU-native shape: each replica is a core-runtime actor holding a jitted
+model on its chip lease; the proxy is a threaded HTTP server in the driver
+routing round-robin across replicas (cc-79: "a managed group of Ray actors
+that ... handle requests load-balanced across them").
+"""
+
+from .deployment import Application, Deployment, DeploymentHandle, deployment
+from .http_adapters import json_request, pandas_read_json
+from .predictor_deployment import PredictorDeployment
+from .proxy import run, shutdown, status
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "PredictorDeployment",
+    "deployment",
+    "json_request",
+    "pandas_read_json",
+    "run",
+    "shutdown",
+    "status",
+]

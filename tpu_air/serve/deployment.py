@@ -1,0 +1,162 @@
+"""Deployment / Application / DeploymentHandle.
+
+A Deployment is "a managed group of Ray actors that ... handle requests
+load-balanced across them" (Introduction_to_Ray_AI_Runtime.ipynb:cc-79).
+``.options(name=..., num_replicas=..., route_prefix=...)`` + ``.bind(*args)``
+mirror the reference call shape (cc-71).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional
+
+from tpu_air.core import api as core_api
+
+
+@dataclass(frozen=True)
+class Deployment:
+    """A replicated callable class. ``func_or_class`` instances run as core
+    runtime actors; each instance handles requests via ``__call__`` (or a
+    named method through the handle)."""
+
+    func_or_class: Any
+    name: str = ""
+    num_replicas: int = 1
+    route_prefix: Optional[str] = None
+    num_cpus: float = 0.0
+    num_chips: float = 0.0
+
+    def options(
+        self,
+        name: Optional[str] = None,
+        num_replicas: Optional[int] = None,
+        route_prefix: Optional[str] = None,
+        num_cpus: Optional[float] = None,
+        num_chips: Optional[float] = None,
+        ray_actor_options: Optional[Dict[str, Any]] = None,
+        **_ignored,
+    ) -> "Deployment":
+        kw: Dict[str, Any] = {}
+        if name is not None:
+            kw["name"] = name
+        if num_replicas is not None:
+            kw["num_replicas"] = num_replicas
+        if route_prefix is not None:
+            kw["route_prefix"] = route_prefix
+        opts = dict(ray_actor_options or {})
+        if num_cpus is not None or "num_cpus" in opts:
+            kw["num_cpus"] = float(num_cpus if num_cpus is not None else opts["num_cpus"])
+        if num_chips is not None or "num_chips" in opts:
+            kw["num_chips"] = float(num_chips if num_chips is not None else opts["num_chips"])
+        return replace(self, **kw)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+
+def deployment(
+    _func_or_class=None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    route_prefix: Optional[str] = None,
+    num_cpus: float = 0.0,
+    num_chips: float = 0.0,
+    **_ignored,
+):
+    """``@serve.deployment`` decorator (bare or parameterized)."""
+
+    def make(obj):
+        return Deployment(
+            func_or_class=obj,
+            name=name or getattr(obj, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            route_prefix=route_prefix,
+            num_cpus=num_cpus,
+            num_chips=num_chips,
+        )
+
+    if _func_or_class is not None:
+        return make(_func_or_class)
+    return make
+
+
+@dataclass
+class Application:
+    """A Deployment bound to constructor args — what ``serve.run`` deploys."""
+
+    deployment: Deployment
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+class _Replica:
+    """Actor body wrapping one instance of the deployment class."""
+
+    def __init__(self, cls, init_args, init_kwargs):
+        self._obj = cls(*init_args, **init_kwargs)
+
+    def handle(self, method: Optional[str], args, kwargs):
+        target = self._obj if method is None else getattr(self._obj, method)
+        return target(*args, **kwargs)
+
+    def handle_http(self, body: bytes):
+        """Adapt the raw request body and invoke the deployment object."""
+        from .http_adapters import json_request
+
+        obj = self._obj
+        if hasattr(obj, "handle_http"):
+            return obj.handle_http(body)
+        adapter = getattr(obj, "_http_adapter", None) or json_request
+        return obj(adapter(body))
+
+    def ping(self):
+        return "ok"
+
+
+class DeploymentHandle:
+    """Round-robin handle over a deployment's live replica actors."""
+
+    def __init__(self, name: str, replicas: List[Any]):
+        self.deployment_name = name
+        self._replicas = replicas
+        self._rr = itertools.cycle(range(len(replicas)))
+        self._lock = threading.Lock()
+
+    def _next_replica(self):
+        with self._lock:
+            return self._replicas[next(self._rr)]
+
+    def remote(self, *args, **kwargs):
+        """Call the replica object (``__call__``); returns an ObjectRef."""
+        return self._next_replica().handle.remote(None, args, kwargs)
+
+    def method(self, name: str) -> Callable:
+        def call(*args, **kwargs):
+            return self._next_replica().handle.remote(name, args, kwargs)
+
+        return call
+
+    def remote_http(self, body: bytes):
+        """Route raw HTTP body bytes to a replica's adapter + callable."""
+        return self._next_replica().handle_http.remote(body)
+
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+
+def start_replicas(app: Application) -> DeploymentHandle:
+    """Instantiate the application's replica actors and wait until live."""
+    from tpu_air.core.remote import remote
+
+    d = app.deployment
+    actor_cls = remote(num_cpus=d.num_cpus, num_chips=d.num_chips)(_Replica)
+    replicas = [
+        actor_cls.remote(d.func_or_class, app.init_args, app.init_kwargs)
+        for _ in range(d.num_replicas)
+    ]
+    core_api.get([r.ping.remote() for r in replicas])  # surface init errors now
+    return DeploymentHandle(d.name, replicas)

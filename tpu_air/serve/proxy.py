@@ -1,0 +1,168 @@
+"""HTTP proxy + serve.run/shutdown.
+
+Request path (SURVEY.md §3.5): client POST :8000 → proxy → route match →
+round-robin replica actor → http_adapter(body) → predictor/callable →
+JSON response.  The proxy is a threaded HTTP server owned by the driver
+process (the "HTTP proxy actor" of the reference, cc-71,74,79).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from tpu_air.core import api as core_api
+
+from .deployment import Application, DeploymentHandle, start_replicas
+
+
+def _to_jsonable(obj: Any) -> Any:
+    import numpy as np
+
+    try:
+        import pandas as pd
+
+        if isinstance(obj, pd.DataFrame):
+            return obj.to_dict(orient="records")
+        if isinstance(obj, pd.Series):
+            return obj.tolist()
+    except ImportError:
+        pass
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    return obj
+
+
+class _ServeState:
+    def __init__(self):
+        self.routes: Dict[str, DeploymentHandle] = {}
+        self.server: Optional[ThreadingHTTPServer] = None
+        self.thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+        self.lock = threading.Lock()
+
+    def match(self, path: str) -> Optional[DeploymentHandle]:
+        best = None
+        for prefix, handle in self.routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(norm + "/") or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, handle)
+        return best[1] if best else None
+
+
+_state = _ServeState()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _respond(self, code: int, payload: Any):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _dispatch(self):
+        from urllib.parse import urlsplit
+
+        self.path = urlsplit(self.path).path
+        if self.path.rstrip("/") == "/-/routes":
+            self._respond(200, {p: h.deployment_name for p, h in _state.routes.items()})
+            return
+        if self.path.rstrip("/") == "/-/healthz":
+            self._respond(200, {"status": "ok"})
+            return
+        handle = _state.match(self.path)
+        if handle is None:
+            self._respond(404, {"error": f"no deployment for route {self.path!r}"})
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        try:
+            ref = handle.remote_http(body)
+            result = core_api.get(ref, timeout=300.0)
+            self._respond(200, _to_jsonable(result))
+        except Exception as e:  # noqa: BLE001 — surface the error to the client
+            self._respond(500, {"error": f"{type(e).__name__}: {e}"})
+
+    do_POST = _dispatch
+    do_GET = _dispatch
+
+
+def run(
+    target: Application,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    name: Optional[str] = None,
+    route_prefix: Optional[str] = None,
+    _blocking: bool = False,
+    **_ignored,
+) -> DeploymentHandle:
+    """Deploy an Application: start its replicas and route HTTP to them."""
+    if not isinstance(target, Application):
+        raise TypeError(
+            "serve.run expects a bound Application — call Deployment.bind(...)"
+        )
+    handle = start_replicas(target)
+    prefix = route_prefix or target.deployment.route_prefix or "/"
+    with _state.lock:
+        _state.routes[prefix] = handle
+        if _state.server is None:
+            server = ThreadingHTTPServer((host, port), _Handler)
+            thread = threading.Thread(target=server.serve_forever, daemon=True)
+            thread.start()
+            _state.server, _state.thread, _state.port = server, thread, port
+        elif port != _state.port:
+            raise RuntimeError(
+                f"serve proxy already running on port {_state.port}; "
+                f"cannot also listen on {port} (call serve.shutdown() first)"
+            )
+    return handle
+
+
+def shutdown() -> None:
+    """Stop the proxy and kill every replica actor."""
+    from tpu_air.core.remote import kill
+
+    with _state.lock:
+        for handle in _state.routes.values():
+            for replica in handle._replicas:
+                try:
+                    kill(replica)
+                except Exception:
+                    pass
+        _state.routes.clear()
+        if _state.server is not None:
+            _state.server.shutdown()
+            _state.server.server_close()
+            _state.server = None
+            _state.thread = None
+            _state.port = None
+
+
+def status() -> Dict[str, Any]:
+    return {
+        "proxy": {"port": _state.port, "running": _state.server is not None},
+        "deployments": {
+            prefix: {
+                "name": h.deployment_name,
+                "num_replicas": h.num_replicas(),
+            }
+            for prefix, h in _state.routes.items()
+        },
+    }

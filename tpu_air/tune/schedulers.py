@@ -33,6 +33,11 @@ class TrialScheduler:
     def on_trial_complete(self, trial_id: str) -> None:
         pass
 
+    def reset(self) -> None:
+        """Clear per-sweep state.  Called at the start of every ``fit()`` so a
+        scheduler instance may be reused across sweeps; stateful built-ins
+        override this."""
+
 
 class FIFOScheduler(TrialScheduler):
     """No early stopping — every trial runs to completion."""
@@ -73,7 +78,10 @@ class ASHAScheduler(TrialScheduler):
         while t < max_t:
             self.milestones.append(int(t))
             t *= reduction_factor
-        self._rungs: Dict[int, List[float]] = {m: [] for m in self.milestones}
+        # per-rung records keyed by trial: a trial joins each rung at most
+        # once, at its first report with t >= milestone (reports may skip
+        # milestone values when the loop's time_attr strides)
+        self._rungs: Dict[int, Dict[str, float]] = {m: {} for m in self.milestones}
         self._stopped: set = set()
 
     def _key(self, metrics: Dict[str, Any]) -> Optional[float]:
@@ -95,16 +103,22 @@ class ASHAScheduler(TrialScheduler):
             return CONTINUE
         decision = CONTINUE
         for m in self.milestones:
-            if t == m:
+            if t >= m and trial_id not in self._rungs[m]:
                 rung = self._rungs[m]
-                rung.append(val)
-                k = max(1, int(len(rung) / self.rf))
-                cutoff = sorted(rung)[k - 1]
+                rung[trial_id] = val
+                vals = sorted(rung.values())
+                k = max(1, int(len(vals) / self.rf))
+                cutoff = vals[k - 1]
                 if val > cutoff:
                     decision = STOP
+                    break  # pruned here; don't join higher rungs
         if decision == STOP:
             self._stopped.add(trial_id)
         return decision
 
     def on_trial_complete(self, trial_id: str) -> None:
         self._stopped.discard(trial_id)
+
+    def reset(self) -> None:
+        self._rungs = {m: {} for m in self.milestones}
+        self._stopped = set()

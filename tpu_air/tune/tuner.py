@@ -114,7 +114,11 @@ class Tuner:
         rt = get_runtime()
         store = rt.store
         tc = self.tune_config
+        # schedulers accumulate rung/stop state per sweep; a second fit() (or
+        # a scheduler shared across Tuners) must not judge trials against a
+        # previous sweep's records
         scheduler = tc.scheduler or FIFOScheduler()
+        scheduler.reset()
         if tc.metric:
             scheduler.set_metric(tc.metric, tc.mode)
 
@@ -219,6 +223,14 @@ class Tuner:
             if err is not None and tr["attempt"] < max_failures and budget_left():
                 tr["attempt"] += 1
                 tpu_air.kill(tr["runner"])
+                # reset per-attempt stream state: drop leftover reports and
+                # any stale stop marker, and restart the report cursor so the
+                # retried attempt's report-1.. stream is drained from its start
+                store.delete(f"{tr['id']}-stop")
+                while store.contains(f"{tr['id']}-report-{tr['next_report']}"):
+                    store.delete(f"{tr['id']}-report-{tr['next_report']}")
+                    tr["next_report"] += 1
+                tr["next_report"] = 1
                 latest = out.get("latest_checkpoint")
                 if latest:
                     tr["config"]["resume_from_checkpoint"] = latest[0]
