@@ -84,18 +84,21 @@ def main() -> None:
     tokens_per_step = batch * (enc_len + dec_len)
     value = tokens_per_step * steps / dt
 
+    metric = f"flan-t5-{'base' if on_tpu else 'tiny'} fine-tune throughput ({platform})"
     vs_baseline = 1.0
     last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST.json")
     try:
         with open(last_path) as f:
             prev = json.load(f)
-        if prev.get("unit") == "tokens/sec/chip" and prev.get("value"):
+        # only comparable if the previous run measured the same metric
+        # (model size + platform are encoded in the metric string)
+        if prev.get("metric") == metric and prev.get("value"):
             vs_baseline = value / float(prev["value"])
     except Exception:
         pass
 
     result = {
-        "metric": f"flan-t5-{'base' if on_tpu else 'tiny'} fine-tune throughput ({platform})",
+        "metric": metric,
         "value": round(value, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 3),

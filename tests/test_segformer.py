@@ -1,0 +1,158 @@
+"""SegFormer model tests: numerical parity with the torch reference
+implementation (transformers, random tiny weights — no network), image
+processor semantics, and loss masking.  Mirrors SURVEY.md §4's small-dials
+strategy (segformer-b0-class tiny configs, Scaling_model_training.ipynb:cc-16).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_air.models.segformer import (  # noqa: E402
+    SegformerConfig,
+    SegformerForSemanticSegmentation,
+    SegformerImageProcessor,
+    config_from_hf,
+    convert_segformer_state_dict,
+    segmentation_loss,
+)
+
+
+@pytest.fixture(scope="module")
+def torch_pair():
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    hf_cfg = transformers.SegformerConfig(
+        num_encoder_blocks=4,
+        depths=[1, 1, 1, 1],
+        sr_ratios=[4, 2, 2, 1],
+        hidden_sizes=[8, 16, 24, 32],
+        patch_sizes=[7, 3, 3, 3],
+        strides=[4, 2, 2, 2],
+        num_attention_heads=[1, 2, 2, 4],
+        mlp_ratios=[2, 2, 2, 2],
+        decoder_hidden_size=32,
+        num_labels=6,
+        drop_path_rate=0.0,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        classifier_dropout_prob=0.0,
+    )
+    transformers.set_seed(42)
+    torch_model = transformers.SegformerForSemanticSegmentation(hf_cfg).eval()
+    config = config_from_hf(hf_cfg)
+    model = SegformerForSemanticSegmentation(config)
+    sd = {k: v.detach().numpy() for k, v in torch_model.state_dict().items()}
+    params, batch_stats = convert_segformer_state_dict(sd, config)
+    variables = {"params": params, "batch_stats": batch_stats}
+    return torch_model, model, variables
+
+
+def test_forward_matches_torch(torch_pair):
+    import torch
+
+    torch_model, model, variables = torch_pair
+    rng = np.random.default_rng(0)
+    img = rng.normal(size=(2, 3, 64, 64)).astype(np.float32)
+
+    with torch.no_grad():
+        ref = torch_model(pixel_values=torch.from_numpy(img)).logits.numpy()
+    # NCHW → NHWC for the TPU-native model
+    ours = model.apply(variables, jnp.asarray(img.transpose(0, 2, 3, 1)))
+    ours = np.transpose(np.asarray(ours), (0, 3, 1, 2))
+    assert ref.shape == ours.shape  # (2, 6, 16, 16): 1/4 resolution
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_train_mode_runs_and_updates_batch_stats(torch_pair):
+    _, model, variables = torch_pair
+    img = jnp.asarray(np.random.default_rng(1).normal(size=(2, 64, 64, 3)), jnp.float32)
+    logits, updates = model.apply(
+        variables,
+        img,
+        deterministic=False,
+        rngs={"dropout": jax.random.PRNGKey(0)},
+        mutable=["batch_stats"],
+    )
+    assert logits.shape == (2, 16, 16, 6)
+    new_mean = updates["batch_stats"]["decode_head"]["batch_norm"]["mean"]
+    assert not np.allclose(
+        np.asarray(new_mean),
+        np.asarray(variables["batch_stats"]["decode_head"]["batch_norm"]["mean"]),
+    )
+
+
+def test_segmentation_loss_masks_ignore_index():
+    cfg = SegformerConfig.tiny()
+    logits = jnp.asarray(np.random.default_rng(2).normal(size=(1, 4, 4, cfg.num_labels)))
+    labels_all_ignored = jnp.full((1, 16, 16), 255, jnp.int32)
+    assert float(segmentation_loss(logits, labels_all_ignored)) == 0.0
+    labels = jnp.zeros((1, 16, 16), jnp.int32)
+    loss = float(segmentation_loss(logits, labels))
+    assert loss > 0.0 and np.isfinite(loss)
+
+
+def test_segmentation_loss_matches_torch_ce(torch_pair):
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(3)
+    logits = rng.normal(size=(2, 4, 4, 5)).astype(np.float32)
+    labels = rng.integers(0, 5, size=(2, 16, 16)).astype(np.int64)
+    labels[0, :4] = 255  # ignored region
+
+    ours = float(segmentation_loss(jnp.asarray(logits), jnp.asarray(labels.astype(np.int32))))
+    up = F.interpolate(
+        torch.from_numpy(logits.transpose(0, 3, 1, 2)),
+        size=(16, 16),
+        mode="bilinear",
+        align_corners=False,
+    )
+    ref = float(F.cross_entropy(up, torch.from_numpy(labels), ignore_index=255))
+    assert abs(ours - ref) < 1e-4
+
+
+def test_image_processor_reduce_labels_and_shapes():
+    proc = SegformerImageProcessor(size=32, do_reduce_labels=True)
+    rng = np.random.default_rng(4)
+    img = rng.integers(0, 256, size=(48, 40, 3)).astype(np.uint8)
+    lbl = rng.integers(0, 10, size=(48, 40)).astype(np.uint8)
+    out = proc([img], segmentation_maps=[lbl])
+    assert out["pixel_values"].shape == (1, 32, 32, 3)
+    assert out["labels"].shape == (1, 32, 32)
+    # reduce_labels: 0 → 255, k → k-1
+    assert set(np.unique(out["labels"])) <= set(range(9)) | {255}
+    # normalized pixel stats in a sane range
+    assert abs(float(out["pixel_values"].mean())) < 3.0
+
+
+def test_image_processor_matches_hf():
+    pytest.importorskip("torch")
+    import transformers
+
+    hf = transformers.SegformerImageProcessor(
+        size={"height": 32, "width": 32}, do_reduce_labels=True
+    )
+    ours = SegformerImageProcessor(size=32, do_reduce_labels=True, data_format="channels_first")
+    rng = np.random.default_rng(5)
+    img = rng.integers(0, 256, size=(48, 40, 3)).astype(np.uint8)
+    lbl = rng.integers(0, 10, size=(48, 40)).astype(np.uint8)
+
+    # NB: pass copies — HF's reduce_labels mutates the input map in place;
+    # ours is non-mutating.
+    ref = hf(images=[img.copy()], segmentation_maps=[lbl.copy()], return_tensors="np")
+    got = ours([img.copy()], segmentation_maps=[lbl.copy()])
+    np.testing.assert_allclose(got["pixel_values"], ref["pixel_values"], atol=1e-4)
+    np.testing.assert_array_equal(got["labels"], np.asarray(ref["labels"]))
+
+
+def test_post_process_semantic_segmentation():
+    proc = SegformerImageProcessor()
+    logits = np.zeros((1, 8, 8, 3), np.float32)
+    logits[..., 1] = 5.0
+    maps = proc.post_process_semantic_segmentation(logits, target_sizes=[(31, 33)])
+    assert maps[0].shape == (31, 33)
+    assert (maps[0] == 1).all()

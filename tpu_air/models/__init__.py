@@ -1,6 +1,6 @@
 """tpu_air.models — Flax model families (L6 compute layer)."""
 
-from . import t5
+from . import segformer, t5
 from .tokenizer import ByteTokenizer, auto_tokenizer
 
-__all__ = ["ByteTokenizer", "auto_tokenizer", "t5"]
+__all__ = ["ByteTokenizer", "auto_tokenizer", "segformer", "t5"]

@@ -1,0 +1,156 @@
+"""SegFormer image (pre)processor — host-side, numpy/PIL only.
+
+Capability target: the reference's `SegformerImageProcessor` /
+`SegformerFeatureExtractor` usage — `do_reduce_labels=True` preprocessing for
+ADE20K fine-tuning (Scaling_model_training.ipynb:cc-38,42) and
+`post_process_semantic_segmentation` at inference
+(Scaling_batch_inference.ipynb:cc-42).
+
+Host-side by design (SURVEY.md §7 stance: preprocessing stays on CPU/Arrow;
+device work enters at step boundaries), NHWC output for the TPU model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def _to_numpy_image(img) -> np.ndarray:
+    """Accept PIL / numpy HWC / numpy CHW; return uint8-or-float HWC RGB."""
+    if hasattr(img, "convert"):  # PIL
+        img = np.asarray(img.convert("RGB"))
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = np.stack([img] * 3, axis=-1)
+    if img.ndim == 3 and img.shape[0] in (1, 3) and img.shape[-1] not in (1, 3):
+        img = np.transpose(img, (1, 2, 0))  # CHW → HWC
+    if img.shape[-1] == 1:
+        img = np.repeat(img, 3, axis=-1)
+    return img
+
+
+def _resize(img: np.ndarray, h: int, w: int, nearest: bool) -> np.ndarray:
+    from PIL import Image
+
+    mode = Image.NEAREST if nearest else Image.BILINEAR
+    if img.ndim == 2:
+        return np.asarray(Image.fromarray(img).resize((w, h), mode))
+    # PIL wants uint8/float32 2D or RGB
+    if img.dtype != np.uint8:
+        chans = [
+            np.asarray(Image.fromarray(img[..., c].astype(np.float32), mode="F").resize((w, h), mode))
+            for c in range(img.shape[-1])
+        ]
+        return np.stack(chans, axis=-1)
+    return np.asarray(Image.fromarray(img).resize((w, h), mode))
+
+
+class SegformerImageProcessor:
+    """Resize → rescale → normalize images; resize(nearest) → reduce labels."""
+
+    def __init__(
+        self,
+        do_resize: bool = True,
+        size: Union[int, Dict[str, int], Tuple[int, int]] = 512,
+        do_rescale: bool = True,
+        rescale_factor: float = 1.0 / 255.0,
+        do_normalize: bool = True,
+        image_mean: Sequence[float] = IMAGENET_MEAN,
+        image_std: Sequence[float] = IMAGENET_STD,
+        do_reduce_labels: bool = False,
+        data_format: str = "channels_last",
+    ):
+        if isinstance(size, int):
+            size = (size, size)
+        elif isinstance(size, dict):
+            size = (size["height"], size["width"])
+        self.size = tuple(size)
+        self.do_resize = do_resize
+        self.do_rescale = do_rescale
+        self.rescale_factor = rescale_factor
+        self.do_normalize = do_normalize
+        self.image_mean = np.asarray(image_mean, np.float32)
+        self.image_std = np.asarray(image_std, np.float32)
+        self.do_reduce_labels = do_reduce_labels
+        self.data_format = data_format
+
+    # -- single-image paths -------------------------------------------------
+    def _process_image(self, img) -> np.ndarray:
+        img = _to_numpy_image(img)
+        if self.do_resize:
+            img = _resize(img, self.size[0], self.size[1], nearest=False)
+        img = img.astype(np.float32)
+        if self.do_rescale:
+            img = img * self.rescale_factor
+        if self.do_normalize:
+            img = (img - self.image_mean) / self.image_std
+        if self.data_format == "channels_first":
+            img = np.transpose(img, (2, 0, 1))
+        return img
+
+    def _process_label(self, lbl) -> np.ndarray:
+        if hasattr(lbl, "convert"):
+            lbl = np.asarray(lbl.convert("L") if lbl.mode not in ("I", "L") else lbl)
+        lbl = np.asarray(lbl)
+        if lbl.ndim == 3:
+            lbl = lbl[..., 0]
+        if self.do_reduce_labels:
+            # ADE20K convention: 0 = "background/unlabeled" → ignore(255);
+            # classes shift down by one.
+            lbl = lbl.astype(np.int32)
+            lbl = np.where(lbl == 0, 255, lbl - 1)
+        if self.do_resize:
+            lbl = _resize(lbl.astype(np.uint8), self.size[0], self.size[1], nearest=True)
+        return lbl.astype(np.int32)
+
+    # -- batch entry point --------------------------------------------------
+    def __call__(
+        self,
+        images,
+        segmentation_maps=None,
+        return_tensors: str = "np",
+        **_: Any,
+    ) -> Dict[str, np.ndarray]:
+        if not isinstance(images, (list, tuple)):
+            images = [images]
+        out = {"pixel_values": np.stack([self._process_image(i) for i in images])}
+        if segmentation_maps is not None:
+            if not isinstance(segmentation_maps, (list, tuple)):
+                segmentation_maps = [segmentation_maps]
+            out["labels"] = np.stack([self._process_label(m) for m in segmentation_maps])
+        return out
+
+    preprocess = __call__
+
+    # -- postprocessing -----------------------------------------------------
+    def post_process_semantic_segmentation(
+        self,
+        logits: np.ndarray,
+        target_sizes: Optional[List[Tuple[int, int]]] = None,
+    ) -> List[np.ndarray]:
+        """(B, h, w, L) NHWC logits → per-image (H, W) int class maps.
+
+        Mirrors the reference's
+        `feature_extractor.post_process_semantic_segmentation(outputs, sizes)`
+        (Scaling_batch_inference.ipynb:cc-42): bilinear-upsample logits to each
+        target size, then argmax.  Host-side: PIL bilinear (half-pixel
+        centers, same convention as the model's internal resize).
+        """
+        logits = np.asarray(logits, np.float32)
+        results = []
+        for i in range(logits.shape[0]):
+            lg = logits[i]
+            if target_sizes is not None:
+                h, w = target_sizes[i]
+                lg = _resize(lg, h, w, nearest=False)
+            results.append(np.argmax(lg, axis=-1).astype(np.int32))
+        return results
+
+
+# The reference imports both names (Scaling_batch_inference.ipynb:cc-24).
+SegformerFeatureExtractor = SegformerImageProcessor

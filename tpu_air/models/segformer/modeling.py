@@ -1,0 +1,312 @@
+"""SegFormer in Flax, written TPU-first (NHWC, static shapes, fused via XLA).
+
+From-scratch implementation of the hierarchical Mix-Transformer encoder and
+the all-MLP decode head (SegFormer, Xie et al. 2021).  Capability target: the
+reference's `SegformerForSemanticSegmentation` fine-tune of `nvidia/mit-b0`
+(Scaling_model_training.ipynb:cc-15-16,52) and batch inference with
+`segformer-b0-finetuned-ade-512-512` (Scaling_batch_inference.ipynb:cc-19-24).
+
+Design notes (TPU):
+- NHWC layout everywhere — XLA:TPU's native conv layout; the MXU sees the
+  channel dim contiguous.
+- Attention over the flattened (H*W) sequence with spatial-reduction convs;
+  softmax in f32, matmuls in the config dtype (bf16 on TPU).
+- BatchNorm in the decode head carries a `batch_stats` collection; training
+  steps pass `mutable=["batch_stats"]`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .config import SegformerConfig
+
+Array = Any
+
+
+def _dtype(config: SegformerConfig):
+    return jnp.dtype(config.dtype)
+
+
+def _resize_bilinear(x: Array, h: int, w: int) -> Array:
+    """Bilinear resize on NHWC, half-pixel centers (== torch align_corners=False)."""
+    return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="bilinear")
+
+
+class DropPath(nn.Module):
+    """Per-sample stochastic depth (the SegFormer block regularizer)."""
+
+    rate: float
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        if deterministic or self.rate == 0.0:
+            return x
+        keep = 1.0 - self.rate
+        rng = self.make_rng("dropout")
+        shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class OverlapPatchEmbed(nn.Module):
+    """Overlapping patch embedding: strided conv + LayerNorm."""
+
+    config: SegformerConfig
+    patch_size: int
+    stride: int
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        p = self.patch_size // 2
+        x = nn.Conv(
+            self.hidden_size,
+            kernel_size=(self.patch_size, self.patch_size),
+            strides=(self.stride, self.stride),
+            padding=[(p, p), (p, p)],
+            dtype=_dtype(self.config),
+            name="proj",
+        )(x)
+        x = nn.LayerNorm(epsilon=self.config.layer_norm_eps, dtype=_dtype(self.config),
+                         name="layer_norm")(x)
+        return x
+
+
+class EfficientSelfAttention(nn.Module):
+    """MHA over the flattened spatial sequence with sequence-reduction convs.
+
+    The sr conv shrinks K/V spatially by `sr_ratio`, so attention cost is
+    O(N * N/sr^2) — this is what makes stage-1 (N = (H/4)(W/4)) tractable.
+    """
+
+    config: SegformerConfig
+    hidden_size: int
+    num_heads: int
+    sr_ratio: int
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        cfg, dt = self.config, _dtype(self.config)
+        b, h, w, c = x.shape
+        head_dim = self.hidden_size // self.num_heads
+
+        q = nn.Dense(self.hidden_size, dtype=dt, name="query")(x.reshape(b, h * w, c))
+
+        kv_src = x
+        if self.sr_ratio > 1:
+            kv_src = nn.Conv(
+                self.hidden_size,
+                kernel_size=(self.sr_ratio, self.sr_ratio),
+                strides=(self.sr_ratio, self.sr_ratio),
+                padding="VALID",
+                dtype=dt,
+                name="sr",
+            )(x)
+            kv_src = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dt,
+                                  name="sr_norm")(kv_src)
+        n_kv = kv_src.shape[1] * kv_src.shape[2]
+        kv_src = kv_src.reshape(b, n_kv, self.hidden_size)
+        k = nn.Dense(self.hidden_size, dtype=dt, name="key")(kv_src)
+        v = nn.Dense(self.hidden_size, dtype=dt, name="value")(kv_src)
+
+        q = q.reshape(b, h * w, self.num_heads, head_dim)
+        k = k.reshape(b, n_kv, self.num_heads, head_dim)
+        v = v.reshape(b, n_kv, self.num_heads, head_dim)
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(head_dim)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        probs = nn.Dropout(cfg.attention_probs_dropout_prob)(
+            probs, deterministic=deterministic
+        )
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, h * w, self.hidden_size)
+        out = nn.Dense(self.hidden_size, dtype=dt, name="out")(out)
+        out = nn.Dropout(cfg.hidden_dropout_prob)(out, deterministic=deterministic)
+        return out.reshape(b, h, w, self.hidden_size)
+
+
+class MixFFN(nn.Module):
+    """Mix-FFN: dense → 3x3 depthwise conv (positional signal) → GELU → dense."""
+
+    config: SegformerConfig
+    hidden_size: int
+    mlp_ratio: int
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        cfg, dt = self.config, _dtype(self.config)
+        inner = self.hidden_size * self.mlp_ratio
+        x = nn.Dense(inner, dtype=dt, name="dense1")(x)
+        x = nn.Conv(
+            inner,
+            kernel_size=(3, 3),
+            padding=[(1, 1), (1, 1)],
+            feature_group_count=inner,
+            dtype=dt,
+            name="dwconv",
+        )(x)
+        x = jax.nn.gelu(x, approximate=False)
+        x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=deterministic)
+        x = nn.Dense(self.hidden_size, dtype=dt, name="dense2")(x)
+        x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=deterministic)
+        return x
+
+
+class Block(nn.Module):
+    config: SegformerConfig
+    hidden_size: int
+    num_heads: int
+    sr_ratio: int
+    mlp_ratio: int
+    drop_path: float
+
+    @nn.compact
+    def __call__(self, x: Array, deterministic: bool = True) -> Array:
+        cfg, dt = self.config, _dtype(self.config)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dt, name="layer_norm_1")(x)
+        h = EfficientSelfAttention(
+            cfg, self.hidden_size, self.num_heads, self.sr_ratio, name="attention"
+        )(h, deterministic)
+        x = x + DropPath(self.drop_path, name="drop_path_attn")(h, deterministic)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dt, name="layer_norm_2")(x)
+        h = MixFFN(cfg, self.hidden_size, self.mlp_ratio, name="mlp")(h, deterministic)
+        x = x + DropPath(self.drop_path, name="drop_path_mlp")(h, deterministic)
+        return x
+
+
+class SegformerEncoder(nn.Module):
+    """4-stage hierarchical encoder; returns all stage feature maps (NHWC)."""
+
+    config: SegformerConfig
+
+    @nn.compact
+    def __call__(self, pixel_values: Array, deterministic: bool = True) -> List[Array]:
+        cfg = self.config
+        # linearly-increasing stochastic-depth schedule over total depth
+        total = sum(cfg.depths)
+        dp_rates = [cfg.drop_path_rate * i / max(total - 1, 1) for i in range(total)]
+
+        x = pixel_values
+        features: List[Array] = []
+        cursor = 0
+        for s in range(cfg.num_encoder_blocks):
+            x = OverlapPatchEmbed(
+                cfg,
+                cfg.patch_sizes[s],
+                cfg.strides[s],
+                cfg.hidden_sizes[s],
+                name=f"patch_embed_{s}",
+            )(x)
+            for d in range(cfg.depths[s]):
+                x = Block(
+                    cfg,
+                    cfg.hidden_sizes[s],
+                    cfg.num_attention_heads[s],
+                    cfg.sr_ratios[s],
+                    cfg.mlp_ratios[s],
+                    dp_rates[cursor],
+                    name=f"block_{s}_{d}",
+                )(x, deterministic)
+                cursor += 1
+            x = nn.LayerNorm(
+                epsilon=cfg.layer_norm_eps,
+                dtype=_dtype(cfg),
+                name=f"stage_norm_{s}",
+            )(x)
+            features.append(x)
+        return features
+
+
+class SegformerDecodeHead(nn.Module):
+    """All-MLP decode head: per-stage linear → upsample to 1/4 res → fuse."""
+
+    config: SegformerConfig
+
+    @nn.compact
+    def __call__(self, features: List[Array], deterministic: bool = True) -> Array:
+        cfg, dt = self.config, _dtype(self.config)
+        h0, w0 = features[0].shape[1], features[0].shape[2]
+        projected = []
+        for i, f in enumerate(features):
+            p = nn.Dense(cfg.decoder_hidden_size, dtype=dt, name=f"linear_c_{i}")(f)
+            if i > 0:
+                p = _resize_bilinear(p, h0, w0)
+            projected.append(p)
+        # fuse deepest-first (matches the published head's concat order)
+        x = jnp.concatenate(projected[::-1], axis=-1)
+        x = nn.Conv(
+            cfg.decoder_hidden_size,
+            kernel_size=(1, 1),
+            use_bias=False,
+            dtype=dt,
+            name="linear_fuse",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=deterministic,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=dt,
+            name="batch_norm",
+        )(x)
+        x = nn.relu(x)
+        x = nn.Dropout(cfg.classifier_dropout_prob)(x, deterministic=deterministic)
+        logits = nn.Conv(cfg.num_labels, kernel_size=(1, 1), dtype=dt,
+                         name="classifier")(x)
+        return logits  # (B, H/4, W/4, num_labels)
+
+
+class SegformerForSemanticSegmentation(nn.Module):
+    """Encoder + decode head.  Input NHWC; logits at 1/4 input resolution."""
+
+    config: SegformerConfig
+
+    def setup(self):
+        self.encoder = SegformerEncoder(self.config)
+        self.decode_head = SegformerDecodeHead(self.config)
+
+    def __call__(self, pixel_values: Array, deterministic: bool = True) -> Array:
+        features = self.encoder(pixel_values, deterministic)
+        return self.decode_head(features, deterministic)
+
+    def features(self, pixel_values: Array, deterministic: bool = True) -> List[Array]:
+        return self.encoder(pixel_values, deterministic)
+
+
+class SegformerForImageClassification(nn.Module):
+    """MiT backbone + mean-pool + linear head (the `nvidia/mit-b0` form)."""
+
+    config: SegformerConfig
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, pixel_values: Array, deterministic: bool = True) -> Array:
+        feats = SegformerEncoder(self.config, name="encoder")(pixel_values, deterministic)
+        x = feats[-1]
+        x = x.reshape(x.shape[0], -1, x.shape[-1]).mean(axis=1)
+        return nn.Dense(self.num_classes, dtype=_dtype(self.config), name="classifier")(x)
+
+
+def segmentation_loss(
+    logits: Array,
+    labels: Array,
+    ignore_index: int = 255,
+) -> Array:
+    """Cross-entropy vs full-resolution integer label maps.
+
+    Upsamples the 1/4-resolution logits to the label size (the published
+    model's training objective) and masks `ignore_index` pixels.
+    logits: (B, h, w, L) NHWC; labels: (B, H, W) int.
+    """
+    h, w = labels.shape[1], labels.shape[2]
+    logits = _resize_bilinear(logits.astype(jnp.float32), h, w)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)

@@ -118,20 +118,34 @@ def run(
         raise TypeError(
             "serve.run expects a bound Application — call Deployment.bind(...)"
         )
-    handle = start_replicas(target)
     prefix = route_prefix or target.deployment.route_prefix or "/"
+    # Validate the port before starting replicas or mutating routes — a
+    # port-mismatch failure must not leave a half-deployed application.
     with _state.lock:
+        if _state.server is not None and port != _state.port:
+            raise RuntimeError(
+                f"serve proxy already running on port {_state.port}; "
+                f"cannot also listen on {port} (call serve.shutdown() first)"
+            )
+    handle = start_replicas(target)
+    with _state.lock:
+        old = _state.routes.get(prefix)
         _state.routes[prefix] = handle
         if _state.server is None:
             server = ThreadingHTTPServer((host, port), _Handler)
             thread = threading.Thread(target=server.serve_forever, daemon=True)
             thread.start()
             _state.server, _state.thread, _state.port = server, thread, port
-        elif port != _state.port:
-            raise RuntimeError(
-                f"serve proxy already running on port {_state.port}; "
-                f"cannot also listen on {port} (call serve.shutdown() first)"
-            )
+    if old is not None:
+        # Redeploy on an existing route: retire the previous deployment's
+        # replicas so their actor processes and chip leases are released.
+        from tpu_air.core.remote import kill
+
+        for replica in old._replicas:
+            try:
+                kill(replica)
+            except Exception:
+                pass
     return handle
 
 
