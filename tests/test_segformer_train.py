@@ -1,0 +1,122 @@
+"""W6 + W7 integration at test dials: SegFormer fine-tune through the
+Trainer stack (Scaling_model_training.ipynb:cc-52 analog) on the virtual
+8-device CPU mesh, then batch inference from the produced checkpoint with
+``SemanticSegmentationPredictor`` (Scaling_batch_inference.ipynb:cc-73-78
+analog)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tpu_air
+from tpu_air import data as tad
+from tpu_air.data import BatchMapper
+from tpu_air.models.segformer import (
+    SegformerConfig,
+    SegformerImageProcessor,
+)
+from tpu_air.predict import BatchPredictor, SemanticSegmentationPredictor
+from tpu_air.train import (
+    CheckpointConfig,
+    RunConfig,
+    ScalingConfig,
+    SegformerTrainer,
+    TrainingArguments,
+)
+
+SIZE = 32
+N_IMAGES = 16
+
+
+def make_ade_like(n=N_IMAGES):
+    """Tiny (image, annotation) rows — the reference's from_items +
+    map_batches ingest shape (Scaling_model_training.ipynb:cc-24,33)."""
+    rng = np.random.default_rng(201)  # reference seed torch.manual_seed(201)
+    rows = []
+    for i in range(n):
+        rows.append(
+            {
+                "image": rng.integers(0, 256, size=(40, 48, 3)).astype(np.uint8),
+                "annotation": rng.integers(0, 9, size=(40, 48)).astype(np.uint8),
+            }
+        )
+    return tad.from_items(rows)
+
+
+def images_preprocessor():
+    """BatchMapper analog of the reference's images_preprocessor (cc-38,42)."""
+
+    def fn(df: pd.DataFrame) -> pd.DataFrame:
+        proc = SegformerImageProcessor(size=SIZE, do_reduce_labels=True)
+        out = proc(list(df["image"]), segmentation_maps=list(df["annotation"]))
+        return pd.DataFrame(
+            {
+                "pixel_values": list(out["pixel_values"]),
+                "labels": list(out["labels"]),
+            }
+        )
+
+    return BatchMapper(fn, batch_format="pandas", batch_size=64)
+
+
+@pytest.fixture(scope="module")
+def seg_result(air):
+    ds = make_ade_like()
+    train_ds, eval_ds = ds.train_test_split(0.25)
+    trainer = SegformerTrainer(
+        model_config=SegformerConfig.tiny(),
+        training_args=TrainingArguments(
+            learning_rate=1e-3,
+            per_device_train_batch_size=1,
+            num_train_epochs=2,
+            weight_decay=0.0,
+        ),
+        feature_extractor=SegformerImageProcessor(size=SIZE),
+        scaling_config=ScalingConfig(num_workers=4, num_chips_per_worker=1),
+        datasets={"train": train_ds, "evaluation": eval_ds},
+        run_config=RunConfig(
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=1,
+                checkpoint_score_attribute="loss",  # cc-51: min train loss
+                checkpoint_score_order="min",
+            )
+        ),
+        preprocessor=images_preprocessor(),
+    )
+    return trainer.fit()
+
+
+def test_w6_fit_produces_metrics_and_checkpoint(seg_result):
+    assert seg_result.error is None
+    assert seg_result.checkpoint is not None
+    m = seg_result.metrics
+    assert "loss" in m and np.isfinite(m["loss"])
+    assert "eval_loss" in m and np.isfinite(m["eval_loss"])
+    assert m["epoch"] == 2
+
+
+def test_w7_batch_predict_from_checkpoint(seg_result, air):
+    rng = np.random.default_rng(7)
+    images = [rng.integers(0, 256, size=(40, 48, 3)).astype(np.uint8) for _ in range(6)]
+    ds = tad.from_items([{"image": im} for im in images])
+    bp = BatchPredictor.from_checkpoint(
+        seg_result.checkpoint,
+        SemanticSegmentationPredictor,
+        feature_extractor=SegformerImageProcessor(size=SIZE),
+    )
+    out = bp.predict(ds, batch_size=3).to_pandas()
+    assert len(out) == 6
+    for mask in out["predicted_mask"]:
+        mask = np.asarray(mask)
+        assert mask.shape == (40, 48)  # restored to original size
+        assert mask.min() >= 0 and mask.max() < SegformerConfig.tiny().num_labels
+
+
+def test_checkpoint_roundtrip_carries_batch_stats(seg_result):
+    ckpt = seg_result.checkpoint
+    pred = SemanticSegmentationPredictor.from_checkpoint(ckpt)
+    assert pred.batch_stats, "batch_stats must survive the checkpoint"
+    # direct single-image path (W4-style escape hatch)
+    img = np.zeros((40, 48, 3), np.uint8)
+    df = pred.predict(pd.DataFrame({"image": [img]}))
+    assert np.asarray(df["predicted_mask"][0]).shape == (40, 48)

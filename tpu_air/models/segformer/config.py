@@ -9,6 +9,8 @@ family (b0-b5); defaults are MiT-b0.
 
 from __future__ import annotations
 
+import dataclasses
+import json
 from dataclasses import dataclass, field
 from typing import List
 
@@ -33,6 +35,20 @@ class SegformerConfig:
     num_labels: int = 150
     semantic_loss_ignore_index: int = 255
     dtype: str = "float32"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SegformerConfig":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["model_type"] = "segformer"  # checkpoint-loader dispatch key
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "SegformerConfig":
+        return cls.from_dict(json.loads(s))
 
     @classmethod
     def mit_b0(cls, **kw) -> "SegformerConfig":

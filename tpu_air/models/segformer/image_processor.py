@@ -152,5 +152,15 @@ class SegformerImageProcessor:
         return results
 
 
+def collate_pixel_batch(values) -> np.ndarray:
+    """Stack per-row pixel arrays into one NHWC float32 batch, accepting CHW
+    rows (torch-layout data) — the single home of the layout heuristic shared
+    by the trainer collate and the segmentation predictor."""
+    px = np.stack([np.asarray(v, dtype=np.float32) for v in values])
+    if px.ndim == 4 and px.shape[1] in (1, 3) and px.shape[-1] not in (1, 3):
+        px = px.transpose(0, 2, 3, 1)
+    return px
+
+
 # The reference imports both names (Scaling_batch_inference.ipynb:cc-24).
 SegformerFeatureExtractor = SegformerImageProcessor

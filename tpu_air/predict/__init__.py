@@ -9,6 +9,7 @@ from tpu_air.predict.predictor import Predictor
 from tpu_air.predict.predictors import (
     GBDTPredictor,
     JaxPredictor,
+    SemanticSegmentationPredictor,
     SklearnPredictor,
     T5GenerativePredictor,
 )
@@ -18,6 +19,7 @@ __all__ = [
     "Predictor",
     "GBDTPredictor",
     "JaxPredictor",
+    "SemanticSegmentationPredictor",
     "SklearnPredictor",
     "T5GenerativePredictor",
 ]

@@ -119,6 +119,96 @@ class JaxPredictor(Predictor):
         return pd.DataFrame({self.output_column: col})
 
 
+class SemanticSegmentationPredictor(Predictor):
+    """SegFormer batch-inference predictor (the reference's custom
+    ``SemanticSegmentationPredictor`` analog,
+    Scaling_batch_inference.ipynb:cc-73): feature-extract → jit forward →
+    ``post_process_semantic_segmentation`` → per-image class maps.
+
+    TPU-first: the forward pass is jit-compiled once per batch shape and runs
+    NHWC on device; pre/post-processing stays host-side.
+    """
+
+    def __init__(self, model, params, batch_stats=None, feature_extractor=None,
+                 preprocessor=None, output_column: str = "predicted_mask"):
+        super().__init__(preprocessor)
+        self.model = model
+        self.params = params
+        self.batch_stats = batch_stats or {}
+        self.feature_extractor = feature_extractor
+        self.output_column = output_column
+        self._jit_forward = None
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint,
+        *,
+        model_cls=None,
+        feature_extractor=None,
+        dtype: Optional[str] = None,
+        **_: Any,
+    ) -> "SemanticSegmentationPredictor":
+        model, params = checkpoint.get_model(model_cls=model_cls, dtype=dtype)
+        # _load_extras returns None for missing files; real load errors
+        # (corrupt pickle etc.) must propagate — silently dropping
+        # batch_stats would surface later as a confusing flax
+        # missing-collection error inside the decode head's BatchNorm.
+        extras = checkpoint._load_extras() or {}
+        if feature_extractor is None:
+            feature_extractor = extras.get("feature_extractor")
+        if feature_extractor is None:
+            from tpu_air.models.segformer import SegformerImageProcessor
+
+            feature_extractor = SegformerImageProcessor()
+        # NB: deliberately does NOT attach the checkpoint's train-time
+        # preprocessor — the reference's segmentation predictor consumes raw
+        # images and applies its feature extractor inside _predict_pandas
+        # (Scaling_batch_inference.ipynb:cc-73); the fitted-preprocessor
+        # auto-apply contract belongs to the tabular/text predictors.
+        return cls(
+            model,
+            params,
+            batch_stats=extras.get("batch_stats"),
+            feature_extractor=feature_extractor,
+        )
+
+    def _forward(self, px):
+        import jax
+        import jax.numpy as jnp
+
+        if self._jit_forward is None:
+            variables = {"params": self.params}
+            if self.batch_stats:
+                variables["batch_stats"] = self.batch_stats
+            self._jit_forward = jax.jit(
+                lambda x: self.model.apply(variables, x)
+            )
+        return self._jit_forward(jnp.asarray(px))
+
+    def _predict_pandas(self, data: pd.DataFrame, **_: Any) -> pd.DataFrame:
+        from tpu_air.models.segformer.image_processor import (
+            _to_numpy_image,
+            collate_pixel_batch,
+        )
+
+        if "pixel_values" in data.columns:
+            px = collate_pixel_batch(data["pixel_values"])
+            sizes = [tuple(px.shape[1:3])] * len(px)
+        else:
+            col = "image" if "image" in data.columns else data.columns[0]
+            # normalize layout first — raw CHW arrays would otherwise yield
+            # (channels, height) target sizes
+            images = [_to_numpy_image(im) for im in data[col]]
+            sizes = [im.shape[:2] for im in images]
+            px = self.feature_extractor(images)["pixel_values"]
+        logits = np.asarray(self._forward(px), np.float32)
+        maps = self.feature_extractor.post_process_semantic_segmentation(
+            logits, target_sizes=sizes
+        )
+        return pd.DataFrame({self.output_column: [m for m in maps]})
+
+
 class GBDTPredictor(Predictor):
     """XGBoostPredictor analog: host-side GBDT scoring (Introduction…ipynb:cc-57)."""
 

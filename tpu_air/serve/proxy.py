@@ -128,14 +128,33 @@ def run(
                 f"cannot also listen on {port} (call serve.shutdown() first)"
             )
     handle = start_replicas(target)
-    with _state.lock:
-        old = _state.routes.get(prefix)
-        _state.routes[prefix] = handle
-        if _state.server is None:
-            server = ThreadingHTTPServer((host, port), _Handler)
-            thread = threading.Thread(target=server.serve_forever, daemon=True)
-            thread.start()
-            _state.server, _state.thread, _state.port = server, thread, port
+    old = None
+    try:
+        with _state.lock:
+            # re-check under the same lock that creates the server — the
+            # early check above is only a fast-fail; this one is authoritative
+            if _state.server is not None and port != _state.port:
+                raise RuntimeError(
+                    f"serve proxy already running on port {_state.port}; "
+                    f"cannot also listen on {port} (call serve.shutdown() first)"
+                )
+            old = _state.routes.get(prefix)
+            _state.routes[prefix] = handle
+            if _state.server is None:
+                server = ThreadingHTTPServer((host, port), _Handler)
+                thread = threading.Thread(target=server.serve_forever, daemon=True)
+                thread.start()
+                _state.server, _state.thread, _state.port = server, thread, port
+    except Exception:
+        # deployment failed after replicas started — retire them
+        from tpu_air.core.remote import kill
+
+        for replica in handle._replicas:
+            try:
+                kill(replica)
+            except Exception:
+                pass
+        raise
     if old is not None:
         # Redeploy on an existing route: retire the previous deployment's
         # replicas so their actor processes and chip leases are released.

@@ -6,6 +6,7 @@ from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from .gbdt_trainer import GBDTTrainer, XGBoostTrainer
 from .result import Result
 from .session import get_dataset_shard, get_session, report
+from .segformer_trainer import SegformerTrainer, segformer_train_loop
 from .t5_trainer import T5Trainer, TrainingArguments, t5_train_loop
 from .trainer import BaseTrainer, JaxTrainer
 
@@ -19,6 +20,7 @@ __all__ = [
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "SegformerTrainer",
     "T5Trainer",
     "TrainingArguments",
     "XGBoostTrainer",
@@ -26,5 +28,6 @@ __all__ = [
     "get_session",
     "report",
     "session",
+    "segformer_train_loop",
     "t5_train_loop",
 ]

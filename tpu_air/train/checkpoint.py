@@ -168,9 +168,13 @@ class Checkpoint:
             return dd["model_config"]
         with open(self._dir_file("model_config.json")) as f:
             raw = f.read()
+        d = json.loads(raw)
+        if d.get("model_type") == "segformer" or "hidden_sizes" in d:
+            from tpu_air.models.segformer import SegformerConfig
+
+            return SegformerConfig.from_dict(d)
         from tpu_air.models.t5 import T5Config
 
-        d = json.loads(raw)
         return T5Config.from_dict(d)
 
     def get_params(self, dtype: Optional[str] = None, sharding=None):
@@ -214,9 +218,17 @@ class Checkpoint:
         if dtype:
             config.dtype = dtype
         if model_cls is None:
+            from tpu_air.models.segformer import (
+                SegformerConfig,
+                SegformerForSemanticSegmentation,
+            )
             from tpu_air.models.t5 import T5ForConditionalGeneration
 
-            model_cls = T5ForConditionalGeneration
+            model_cls = (
+                SegformerForSemanticSegmentation
+                if isinstance(config, SegformerConfig)
+                else T5ForConditionalGeneration
+            )
         model = model_cls(config)
         return model, self.get_params(dtype=None, sharding=sharding)
 

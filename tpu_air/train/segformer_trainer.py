@@ -1,0 +1,239 @@
+"""SegformerTrainer — semantic-segmentation fine-tune engine (W6,
+Scaling_model_training.ipynb).
+
+Replaces the reference's per-worker HF ``Trainer`` over
+``SegformerForSemanticSegmentation`` with explicit AdamW + identity LambdaLR
+and 2-worker CPU-Gloo DDP (cc-47,51-53) with one jit-compiled SPMD step on a
+``data`` mesh axis: the batch is sharded per device, gradient sync is the
+psum XLA emits, and the decode head's BatchNorm statistics are cross-replica
+by construction (XLA computes the batch moments over the global sharded
+batch — stronger than torch DDP, which keeps per-replica BN stats).
+
+Expected dataset columns (produced by the image-processor BatchMapper, the
+``images_preprocessor`` analog, cc-38,42): ``pixel_values`` (HWC float) and
+``labels`` (HW int, 255 = ignore).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .checkpoint import Checkpoint
+from .t5_trainer import TrainingArguments, _make_optimizer
+from .trainer import BaseTrainer
+
+
+def _collate_images(batch_df) -> Dict[str, np.ndarray]:
+    from tpu_air.models.segformer.image_processor import collate_pixel_batch
+
+    out = {"pixel_values": collate_pixel_batch(batch_df["pixel_values"])}
+    if "labels" in batch_df.columns:
+        out["labels"] = np.stack(
+            [np.asarray(v, dtype=np.int32) for v in batch_df["labels"]]
+        )
+    return out
+
+
+def segformer_train_loop(config: Dict[str, Any]) -> None:
+    """SPMD training fn (runs inside the trial actor on its chip lease)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_air.models.segformer import (
+        SegformerConfig,
+        SegformerForSemanticSegmentation,
+        segmentation_loss,
+    )
+    from tpu_air.parallel import make_mesh, visible_devices
+    from tpu_air.train import session
+
+    args: TrainingArguments = config.get("training_args") or TrainingArguments()
+    for k in ("learning_rate", "num_train_epochs", "weight_decay"):
+        if k in config:
+            setattr(args, k, config[k])
+    if "epochs" in config:
+        args.num_train_epochs = config["epochs"]
+
+    model_config: SegformerConfig = config["model_config"]
+    preprocessor = config.get("_preprocessor")
+    feature_extractor = config.get("feature_extractor")
+
+    devs = visible_devices()
+    dp = len(devs)
+    mesh = make_mesh(("data",), (dp,), devices=devs)
+    model = SegformerForSemanticSegmentation(model_config)
+    ignore = model_config.semantic_loss_ignore_index
+
+    train_ds = session.get_dataset_shard("train")
+    eval_ds = session.get_dataset_shard("evaluation")
+    if eval_ds is None:
+        eval_ds = session.get_dataset_shard("eval")
+    if train_ds is None:
+        raise ValueError("SegformerTrainer requires a 'train' dataset")
+    global_bs = args.per_device_train_batch_size * dp
+
+    # -- variables ----------------------------------------------------------
+    sample = _collate_images(
+        next(train_ds.iter_batches(batch_size=1, batch_format="pandas"))
+    )
+    h, w = sample["pixel_values"].shape[1:3]
+
+    resume_dir = config.get("resume_from_checkpoint")
+    pretrained = config.get("pretrained_variables")
+    if resume_dir:
+        ckpt = Checkpoint.from_directory(resume_dir)
+        params = ckpt.get_params()
+        extras = ckpt._load_extras() or {}
+        bstats = extras.get("batch_stats") or {}
+    elif pretrained is not None:
+        params, bstats = pretrained["params"], pretrained.get("batch_stats", {})
+    else:
+        init = model.init(
+            jax.random.PRNGKey(args.seed),
+            jnp.zeros((1, h, w, model_config.num_channels)),
+        )
+        params, bstats = init["params"], init.get("batch_stats", {})
+
+    n_train = train_ds.count()
+    steps_per_epoch = max(1, n_train // global_bs)
+    if args.max_steps_per_epoch:
+        steps_per_epoch = min(steps_per_epoch, args.max_steps_per_epoch)
+    tx = _make_optimizer(args, steps_per_epoch * args.num_train_epochs)
+
+    rep = NamedSharding(mesh, P())
+    batch_sharding = NamedSharding(mesh, P("data"))
+    params = jax.device_put(params, rep)
+    bstats = jax.device_put(bstats, rep)
+    opt_state = tx.init(params)
+
+    # -- steps --------------------------------------------------------------
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(p, bs, o, px, lb, rng):
+        rng, sub = jax.random.split(rng)
+
+        def lf(pp):
+            logits, upd = model.apply(
+                {"params": pp, "batch_stats": bs},
+                px,
+                deterministic=False,
+                rngs={"dropout": sub},
+                mutable=["batch_stats"],
+            )
+            return segmentation_loss(logits, lb, ignore), upd["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(lf, has_aux=True)(p)
+        updates, o = tx.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return p, new_bs, o, loss, rng
+
+    @jax.jit
+    def eval_step(p, bs, px, lb):
+        logits = model.apply({"params": p, "batch_stats": bs}, px)
+        return segmentation_loss(logits, lb, ignore)
+
+    def put(b):
+        return {
+            k: jax.device_put(jnp.asarray(v), batch_sharding) for k, v in b.items()
+        }
+
+    rng = jax.device_put(jax.random.PRNGKey(args.seed + 1), rep)
+
+    # -- epochs -------------------------------------------------------------
+    for epoch in range(int(args.num_train_epochs)):
+        t0 = time.time()
+        losses, nsteps, nimg = [], 0, 0
+        for batch_df in train_ds.iter_batches(
+            batch_size=global_bs, batch_format="pandas", drop_last=True
+        ):
+            b = put(_collate_images(batch_df))
+            params, bstats, opt_state, loss, rng = train_step(
+                params, bstats, opt_state, b["pixel_values"], b["labels"], rng
+            )
+            losses.append(loss)
+            nsteps += 1
+            nimg += global_bs
+            if args.max_steps_per_epoch and nsteps >= args.max_steps_per_epoch:
+                break
+        dt = time.time() - t0
+        train_loss = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+        metrics: Dict[str, Any] = {
+            "epoch": epoch + 1,
+            "loss": train_loss,
+            "steps": nsteps,
+            "train_images_per_sec": nimg / dt if dt > 0 else 0.0,
+        }
+
+        if eval_ds is not None and args.evaluation_strategy == "epoch":
+            tot, cnt = 0.0, 0
+            for batch_df in eval_ds.iter_batches(
+                batch_size=global_bs, batch_format="pandas", drop_last=True
+            ):
+                b = put(_collate_images(batch_df))
+                tot += float(eval_step(params, bstats, b["pixel_values"], b["labels"]))
+                cnt += 1
+            if cnt:
+                metrics["eval_loss"] = tot / cnt
+
+        ckpt = None
+        if args.save_strategy == "epoch":
+            ckpt = Checkpoint.from_model(
+                model_config=model_config,
+                params=params,
+                preprocessor=preprocessor,
+                metrics=metrics,
+                extras={
+                    "batch_stats": jax.tree_util.tree_map(np.asarray, bstats),
+                    **({"feature_extractor": feature_extractor} if feature_extractor else {}),
+                },
+            )
+        session.report(metrics, checkpoint=ckpt)
+
+
+class SegformerTrainer(BaseTrainer):
+    """Drop-in for the reference's HuggingFaceTrainer-on-SegFormer config
+    (Scaling_model_training.ipynb:cc-51-52)."""
+
+    _name_prefix = "SegformerTrainer"
+
+    def __init__(
+        self,
+        *,
+        model_config=None,
+        training_args: Optional[TrainingArguments] = None,
+        pretrained_variables=None,
+        feature_extractor=None,
+        trainer_init_config: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        if model_config is None:
+            from tpu_air.models.segformer import SegformerConfig
+
+            model_config = SegformerConfig.mit_b0()
+        self.model_config = model_config
+        self.training_args = training_args or TrainingArguments(
+            learning_rate=1e-4, weight_decay=0.0
+        )
+        self.pretrained_variables = pretrained_variables
+        self.feature_extractor = feature_extractor
+        self.trainer_init_config = trainer_init_config or {}
+
+    def _training_fn(self):
+        return segformer_train_loop
+
+    def _train_loop_config(self) -> Dict[str, Any]:
+        cfg = dict(self.trainer_init_config)
+        cfg["model_config"] = self.model_config
+        cfg["training_args"] = self.training_args
+        if self.pretrained_variables is not None:
+            cfg["pretrained_variables"] = self.pretrained_variables
+        if self.feature_extractor is not None:
+            cfg["feature_extractor"] = self.feature_extractor
+        return cfg

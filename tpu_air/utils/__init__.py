@@ -1,5 +1,23 @@
 """tpu_air.utils — cross-cutting helpers."""
 
 from .display import get_random_elements
+from .segmentation import (
+    ade_palette,
+    convert_image_to_rgb,
+    display_example_images,
+    get_image_indices,
+    get_labels,
+    prepare_pixels_with_segmentation,
+    visualize_predictions,
+)
 
-__all__ = ["get_random_elements"]
+__all__ = [
+    "ade_palette",
+    "convert_image_to_rgb",
+    "display_example_images",
+    "get_image_indices",
+    "get_labels",
+    "get_random_elements",
+    "prepare_pixels_with_segmentation",
+    "visualize_predictions",
+]
