@@ -1,0 +1,138 @@
+"""C++ shared-memory arena store tests (tpu_air/_native/store.cpp): layout,
+atomic seal visibility across fork, zero-copy reads, fallback behavior.
+The plasma-analog component of SURVEY.md §2B."""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from tpu_air.core import serialization
+from tpu_air.core.object_store import ObjectStore, new_object_id
+from tpu_air.core.shm_arena import Arena, open_arena
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = ObjectStore(str(tmp_path / "store"), create=True)
+    yield s
+    s.destroy()
+
+
+def test_arena_available(store):
+    assert store._arena is not None, "native arena must build in this environment"
+
+
+def test_roundtrip_through_arena(store):
+    arr = np.arange(10000, dtype=np.float64)
+    ref = store.put({"x": arr, "tag": "hello"})
+    # object must live in the arena, not a file
+    assert store._arena.contains(ref.id)
+    assert not os.path.exists(os.path.join(store.root, ref.id))
+    out = store.get(ref.id)
+    np.testing.assert_array_equal(out["x"], arr)
+    assert out["tag"] == "hello"
+
+
+def test_zero_copy_read_is_view(store):
+    arr = np.arange(4096, dtype=np.uint8)
+    ref = store.put(arr)
+    out = store.get(ref.id)
+    # zero-copy contract: the result array's buffer is not a fresh copy —
+    # it must be backed by the shared mapping (not writeable)
+    assert not out.flags["OWNDATA"]
+
+
+def test_large_object_falls_back_to_file(tmp_path):
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    # 1 MB arena → an 8 MB payload must take the file path
+    Arena(os.path.join(root, "__arena__"), create=True, capacity=1 << 20, slots=1 << 10)
+    s = ObjectStore(root)
+    big = np.zeros(1 << 23, dtype=np.uint8)
+    ref = s.put(big)
+    assert os.path.exists(os.path.join(root, ref.id))
+    np.testing.assert_array_equal(s.get(ref.id), big)
+    # small objects still use the arena
+    small_ref = s.put(b"tiny")
+    assert s._arena.contains(small_ref.id)
+    assert s.get(small_ref.id) == b"tiny"
+    s.destroy()
+
+
+def test_delete_tombstones_and_id_reuse_safe(store):
+    ref = store.put([1, 2, 3])
+    assert store.contains(ref.id)
+    store.delete(ref.id)
+    assert not store.contains(ref.id)
+    # tombstoned slot doesn't break probing for other ids
+    for _ in range(32):
+        r = store.put("v")
+        assert store.get(r.id) == "v"
+
+
+def test_stats_track_objects(store):
+    before = store._arena.stats()
+    store.put(np.zeros(1000, np.uint8))
+    after = store._arena.stats()
+    assert after["live_objects"] == before["live_objects"] + 1
+    assert after["sealed_bytes"] > before["sealed_bytes"]
+    assert after["used"] <= after["capacity"]
+
+
+def _child_put(root, oid, q):
+    s = ObjectStore(root)
+    s.put(np.full(5000, 7, dtype=np.int32), object_id=oid)
+    q.put("done")
+
+
+def test_cross_process_visibility(store):
+    """Writer in a forked child, reader in the parent — exercises the
+    acquire/release seal protocol on the shared mapping."""
+    ctx = multiprocessing.get_context("fork")
+    oid = new_object_id()
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_put, args=(store.root, oid, q))
+    p.start()
+    out = store.get(oid, timeout=30)
+    p.join(timeout=10)
+    assert q.get(timeout=10) == "done"
+    np.testing.assert_array_equal(out, np.full(5000, 7, dtype=np.int32))
+
+
+def test_concurrent_writers_distinct_objects(store):
+    """N forked writers allocate concurrently from the bump allocator."""
+    ctx = multiprocessing.get_context("fork")
+    oids = [new_object_id() for _ in range(8)]
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_child_put, args=(store.root, oid, q)) for oid in oids
+    ]
+    for p in procs:
+        p.start()
+    for oid in oids:
+        np.testing.assert_array_equal(
+            store.get(oid, timeout=30), np.full(5000, 7, dtype=np.int32)
+        )
+    for p in procs:
+        p.join(timeout=10)
+
+
+def test_open_arena_missing_compiler_is_none(tmp_path, monkeypatch):
+    """Fallback contract: when the native build fails, the store must still
+    work through the file path."""
+    import tpu_air._native as native
+
+    def boom():
+        raise OSError("no compiler")
+
+    monkeypatch.setattr(native, "load_store_lib", boom)
+    root = str(tmp_path / "store2")
+    os.makedirs(root)
+    assert open_arena(root, create=True) is None
+    s = ObjectStore(root)
+    assert s._arena is None
+    ref = s.put({"a": 1})
+    assert s.get(ref.id) == {"a": 1}
+    s.destroy()

@@ -52,6 +52,7 @@ _LAZY_SUBMODULES = (
     "models",
     "ops",
     "job",
+    "observability",
     "utils",
 )
 

@@ -1,0 +1,56 @@
+"""Native (C++) runtime components — loader.
+
+Builds lazily with the system toolchain on first import (single translation
+unit, sub-second) and caches the .so next to the sources.  Everything here is
+optional: importers must catch ImportError/OSError and fall back to the pure-
+Python paths, so environments without a compiler still work.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libtpu_air_store.so")
+
+
+def _ensure_built() -> str:
+    src = os.path.join(_DIR, "store.cpp")
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(src):
+        subprocess.run(
+            ["sh", os.path.join(_DIR, "build.sh")],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    return _SO
+
+
+def load_store_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_ensure_built())
+    lib.arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+    lib.arena_create.restype = ctypes.c_int
+    lib.arena_open.argtypes = [ctypes.c_char_p]
+    lib.arena_open.restype = ctypes.c_int
+    lib.arena_close.argtypes = [ctypes.c_int]
+    lib.arena_close.restype = ctypes.c_int
+    lib.arena_alloc.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64]
+    lib.arena_alloc.restype = ctypes.c_int64
+    lib.arena_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.arena_seal.restype = ctypes.c_int
+    lib.arena_lookup.argtypes = [
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.arena_lookup.restype = ctypes.c_int
+    lib.arena_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.arena_delete.restype = ctypes.c_int
+    for fn in ("arena_capacity", "arena_used", "arena_live_objects", "arena_sealed_bytes"):
+        f = getattr(lib, fn)
+        f.argtypes = [ctypes.c_int]
+        f.restype = ctypes.c_uint64
+    return lib

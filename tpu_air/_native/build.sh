@@ -1,0 +1,14 @@
+#!/bin/sh
+# Build the native components into this directory.
+# Idempotent; skips the compile when the .so is newer than its sources.
+# Atomic: compiles to a temp name and renames, so concurrent builders never
+# corrupt a .so another process is loading, and a rebuild never truncates a
+# library that is currently mapped (the old inode lives on).
+set -e
+cd "$(dirname "$0")"
+if [ libtpu_air_store.so -nt store.cpp ] 2>/dev/null; then
+  exit 0
+fi
+tmp="libtpu_air_store.so.tmp.$$"
+${CXX:-g++} -std=c++17 -O2 -shared -fPIC -o "$tmp" store.cpp -lpthread
+mv -f "$tmp" libtpu_air_store.so

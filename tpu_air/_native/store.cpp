@@ -1,0 +1,299 @@
+// tpu_air native shared-memory object store (plasma analog, SURVEY.md §2B:
+// "per-node shared-memory store; zero-copy Arrow objects" → C++ equivalent).
+//
+// One mmap'd arena file in /dev/shm shared by every process on the host:
+//   [Header | index slots | data region]
+// - Allocation is a lock-free bump allocator (fetch_add on the header cursor).
+// - The index is a fixed-capacity open-addressing hash table; slot state
+//   machines (EMPTY→CLAIMED→SEALED→TOMBSTONE) use C++11 atomics on the shared
+//   mapping, so readers never take a lock and a reader either observes a
+//   fully sealed object (acquire on state) or none.
+// - Objects are immutable (Overview_of_Ray.ipynb:cc-4); delete tombstones the
+//   slot but never reuses data space, so zero-copy readers in other processes
+//   are never invalidated.
+//
+// The Python side maps the same file and does the payload memcpy itself
+// (writes go straight into shared memory; reads are memoryview slices of the
+// mapping — zero copies end to end). This library owns layout + atomics.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7470755F61697231ULL;  // "tpu_air1"
+// Fixed-width object key. Python passes sha256(object_id) — ids of any
+// length map to exactly 32 key bytes (embedded NULs fine; never strlen'd).
+constexpr uint32_t kIdBytes = 32;
+
+enum SlotState : uint32_t {
+  kEmpty = 0,
+  kClaimed = 1,
+  kSealed = 2,
+  kTombstone = 3,
+};
+
+struct Slot {
+  std::atomic<uint32_t> state;
+  uint32_t probe_dist;  // reserved
+  uint8_t id[kIdBytes];
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;      // bytes of data region
+  uint64_t data_start;    // file offset of data region
+  std::atomic<uint64_t> cursor;  // next free byte in data region (relative)
+  uint32_t num_slots;     // power of two
+  uint32_t _pad;
+  std::atomic<uint64_t> live_objects;
+  std::atomic<uint64_t> sealed_bytes;
+};
+
+struct Arena {
+  uint8_t* base = nullptr;
+  uint64_t mapped = 0;
+  Header* hdr = nullptr;
+  Slot* slots = nullptr;
+};
+
+constexpr int kMaxArenas = 64;
+Arena g_arenas[kMaxArenas];
+bool g_used[kMaxArenas] = {};
+std::mutex g_handles_mu;  // guards g_used slot assignment (per-process)
+
+uint64_t fnv1a(const uint8_t* id) {
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kIdBytes; ++i) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+bool id_eq(const uint8_t* a, const uint8_t* b) {
+  return std::memcmp(a, b, kIdBytes) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create + initialize an arena file. Returns 0 on success.
+int arena_create(const char* path, uint64_t capacity, uint32_t num_slots) {
+  if ((num_slots & (num_slots - 1)) != 0) return -2;  // must be pow2
+  uint64_t index_bytes = uint64_t(num_slots) * sizeof(Slot);
+  uint64_t data_start = (sizeof(Header) + index_bytes + 4095) & ~4095ULL;
+  uint64_t total = data_start + capacity;
+
+  int fd = ::open(path, O_RDWR | O_CREAT | O_EXCL, 0644);
+  if (fd < 0) return -1;
+  if (::ftruncate(fd, (off_t)total) != 0) {
+    ::close(fd);
+    ::unlink(path);
+    return -3;
+  }
+  void* mem = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return -4;
+
+  Header* hdr = reinterpret_cast<Header*>(mem);
+  std::memset(mem, 0, sizeof(Header) + index_bytes);
+  hdr->capacity = capacity;
+  hdr->data_start = data_start;
+  hdr->cursor.store(0, std::memory_order_relaxed);
+  hdr->num_slots = num_slots;
+  hdr->live_objects.store(0, std::memory_order_relaxed);
+  hdr->sealed_bytes.store(0, std::memory_order_relaxed);
+  // magic last, release: openers spin on it to know init is complete
+  reinterpret_cast<std::atomic<uint64_t>*>(&hdr->magic)
+      ->store(kMagic, std::memory_order_release);
+  ::munmap(mem, total);
+  return 0;
+}
+
+// Open an existing arena. Returns handle >= 0, or < 0 on error.
+int arena_open(const char* path) {
+  int fd = ::open(path, O_RDWR);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return -2;
+  }
+  void* mem =
+      ::mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return -3;
+  Header* hdr = reinterpret_cast<Header*>(mem);
+  if (reinterpret_cast<std::atomic<uint64_t>*>(&hdr->magic)
+          ->load(std::memory_order_acquire) != kMagic) {
+    ::munmap(mem, (size_t)st.st_size);
+    return -4;
+  }
+  std::lock_guard<std::mutex> lock(g_handles_mu);
+  for (int h = 0; h < kMaxArenas; ++h) {
+    if (g_used[h]) continue;
+    g_used[h] = true;
+    g_arenas[h].base = reinterpret_cast<uint8_t*>(mem);
+    g_arenas[h].mapped = (uint64_t)st.st_size;
+    g_arenas[h].hdr = hdr;
+    g_arenas[h].slots = reinterpret_cast<Slot*>(reinterpret_cast<uint8_t*>(mem) +
+                                                sizeof(Header));
+    return h;
+  }
+  ::munmap(mem, (size_t)st.st_size);  // handle table full — don't leak
+  return -5;
+}
+
+// Unmap this process's view and free the handle for reuse. Safe while other
+// mappings of the file (e.g. Python's own mmap serving zero-copy views)
+// remain open.
+int arena_close(int h) {
+  std::lock_guard<std::mutex> lock(g_handles_mu);
+  if (h < 0 || h >= kMaxArenas || !g_used[h]) return -1;
+  ::munmap(g_arenas[h].base, (size_t)g_arenas[h].mapped);
+  g_arenas[h] = Arena{};
+  g_used[h] = false;
+  return 0;
+}
+
+// Claim an index slot + bump-allocate `size` bytes for object `id`.
+// Returns the absolute file offset the caller writes payload to, or:
+//   -1 arena full   -2 index full   -3 duplicate id   -4 bad handle
+int64_t arena_alloc(int h, const uint8_t* id, uint64_t size) {
+  if (h < 0 || h >= kMaxArenas || !g_arenas[h].hdr) return -4;
+  Arena& a = g_arenas[h];
+  Header* hdr = a.hdr;
+
+  uint64_t off = hdr->cursor.fetch_add(size, std::memory_order_relaxed);
+  if (off + size > hdr->capacity) {
+    // roll back our reservation if nobody allocated after us (best effort —
+    // on failure the space is simply abandoned; the store falls back to the
+    // file path for this object anyway)
+    uint64_t expect = off + size;
+    hdr->cursor.compare_exchange_strong(expect, off, std::memory_order_relaxed);
+    return -1;
+  }
+
+  uint32_t mask = hdr->num_slots - 1;
+  uint32_t idx = (uint32_t)(fnv1a(id)) & mask;
+  for (uint32_t probe = 0; probe < hdr->num_slots; ++probe, idx = (idx + 1) & mask) {
+    Slot& s = a.slots[idx];
+    uint32_t st = s.state.load(std::memory_order_acquire);
+    if (st == kEmpty) {
+      uint32_t expected = kEmpty;
+      if (s.state.compare_exchange_strong(expected, kClaimed,
+                                          std::memory_order_acq_rel)) {
+        std::memcpy(s.id, id, kIdBytes);
+        s.offset = off;
+        s.size = size;
+        return (int64_t)(hdr->data_start + off);
+      }
+      st = s.state.load(std::memory_order_acquire);  // lost race; re-read
+    }
+    if ((st == kClaimed || st == kSealed) && id_eq(s.id, id)) return -3;
+    // tombstone or other id → keep probing
+  }
+  return -2;
+}
+
+// Publish a claimed object. Returns 0, or -1 if not found/claimed.
+int arena_seal(int h, const uint8_t* id) {
+  if (h < 0 || h >= kMaxArenas || !g_arenas[h].hdr) return -1;
+  Arena& a = g_arenas[h];
+  uint32_t mask = a.hdr->num_slots - 1;
+  uint32_t idx = (uint32_t)(fnv1a(id)) & mask;
+  for (uint32_t probe = 0; probe < a.hdr->num_slots; ++probe, idx = (idx + 1) & mask) {
+    Slot& s = a.slots[idx];
+    uint32_t st = s.state.load(std::memory_order_acquire);
+    if (st == kEmpty) return -1;
+    if ((st == kClaimed) && id_eq(s.id, id)) {
+      a.hdr->live_objects.fetch_add(1, std::memory_order_relaxed);
+      a.hdr->sealed_bytes.fetch_add(s.size, std::memory_order_relaxed);
+      s.state.store(kSealed, std::memory_order_release);
+      return 0;
+    }
+    if (st == kSealed && id_eq(s.id, id)) return 0;  // idempotent
+  }
+  return -1;
+}
+
+// Look up a sealed object. Returns 1 (sealed; *offset/*size filled),
+// 0 (unknown or still being written), or negative on bad handle.
+int arena_lookup(int h, const uint8_t* id, uint64_t* offset, uint64_t* size) {
+  if (h < 0 || h >= kMaxArenas || !g_arenas[h].hdr) return -4;
+  Arena& a = g_arenas[h];
+  uint32_t mask = a.hdr->num_slots - 1;
+  uint32_t idx = (uint32_t)(fnv1a(id)) & mask;
+  for (uint32_t probe = 0; probe < a.hdr->num_slots; ++probe, idx = (idx + 1) & mask) {
+    Slot& s = a.slots[idx];
+    uint32_t st = s.state.load(std::memory_order_acquire);
+    if (st == kEmpty) return 0;
+    if (st == kSealed && id_eq(s.id, id)) {
+      *offset = a.hdr->data_start + s.offset;
+      *size = s.size;
+      return 1;
+    }
+    if (st == kClaimed && id_eq(s.id, id)) return 0;  // pending
+    // tombstone / other id → continue
+  }
+  return 0;
+}
+
+// Tombstone an object. Space is NOT reclaimed (zero-copy reader safety).
+int arena_delete(int h, const uint8_t* id) {
+  if (h < 0 || h >= kMaxArenas || !g_arenas[h].hdr) return -4;
+  Arena& a = g_arenas[h];
+  uint32_t mask = a.hdr->num_slots - 1;
+  uint32_t idx = (uint32_t)(fnv1a(id)) & mask;
+  for (uint32_t probe = 0; probe < a.hdr->num_slots; ++probe, idx = (idx + 1) & mask) {
+    Slot& s = a.slots[idx];
+    uint32_t st = s.state.load(std::memory_order_acquire);
+    if (st == kEmpty) return 0;
+    if ((st == kSealed || st == kClaimed) && id_eq(s.id, id)) {
+      if (st == kSealed) {
+        a.hdr->live_objects.fetch_sub(1, std::memory_order_relaxed);
+        a.hdr->sealed_bytes.fetch_sub(s.size, std::memory_order_relaxed);
+      }
+      s.state.store(kTombstone, std::memory_order_release);
+      return 0;
+    }
+  }
+  return 0;
+}
+
+uint64_t arena_capacity(int h) {
+  return (h >= 0 && h < kMaxArenas && g_arenas[h].hdr) ? g_arenas[h].hdr->capacity : 0;
+}
+
+uint64_t arena_used(int h) {
+  if (h < 0 || h >= kMaxArenas || !g_arenas[h].hdr) return 0;
+  uint64_t c = g_arenas[h].hdr->cursor.load(std::memory_order_relaxed);
+  uint64_t cap = g_arenas[h].hdr->capacity;
+  return c < cap ? c : cap;
+}
+
+uint64_t arena_live_objects(int h) {
+  return (h >= 0 && h < kMaxArenas && g_arenas[h].hdr)
+             ? g_arenas[h].hdr->live_objects.load(std::memory_order_relaxed)
+             : 0;
+}
+
+uint64_t arena_sealed_bytes(int h) {
+  return (h >= 0 && h < kMaxArenas && g_arenas[h].hdr)
+             ? g_arenas[h].hdr->sealed_bytes.load(std::memory_order_relaxed)
+             : 0;
+}
+
+}  // extern "C"

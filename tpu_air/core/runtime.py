@@ -705,17 +705,42 @@ def init(
     num_cpus: Optional[int] = None,
     num_chips: Optional[int] = None,
     ignore_reinit_error: bool = True,
+    include_dashboard: Optional[bool] = None,
+    dashboard_port: int = 8265,
     **kwargs,
 ) -> Runtime:
     """Start the tpu_air runtime (the ``ray.init()`` analog,
-    Install_locally.md:58-64). Idempotent by default."""
+    Install_locally.md:58-64). Idempotent by default.
+
+    ``include_dashboard=True`` starts the status service at
+    127.0.0.1:<dashboard_port> and prints the URL — the reference's
+    "Follow the link … to open the Ray Dashboard" flow
+    (Model_finetuning…ipynb:cc-9).  Default off (None) to keep tests quiet;
+    set env TPU_AIR_DASHBOARD=1 to default on.
+    """
     global _runtime
     if _runtime is not None:
-        if ignore_reinit_error:
-            return _runtime
-        raise TpuAirError("tpu_air.init() called twice")
+        if not ignore_reinit_error:
+            raise TpuAirError("tpu_air.init() called twice")
+        if include_dashboard:  # honor an explicit request on reinit too
+            _start_dashboard(dashboard_port)
+        return _runtime
     _runtime = Runtime(num_cpus=num_cpus, num_chips=num_chips, **kwargs)
+    if include_dashboard is None:
+        include_dashboard = os.environ.get("TPU_AIR_DASHBOARD", "0") == "1"
+    if include_dashboard:
+        _start_dashboard(dashboard_port)
     return _runtime
+
+
+def _start_dashboard(port: int) -> None:
+    try:
+        from tpu_air.observability import start_dashboard
+
+        url = start_dashboard(port=port)
+        print(f"tpu_air dashboard: {url}")
+    except OSError as e:
+        print(f"tpu_air dashboard failed to start: {e}")
 
 
 def is_initialized() -> bool:
@@ -725,6 +750,12 @@ def is_initialized() -> bool:
 def shutdown():
     global _runtime
     if _runtime is not None:
+        try:
+            from tpu_air.observability import stop_dashboard
+
+            stop_dashboard()
+        except Exception:
+            pass
         _runtime.shutdown()
         _runtime = None
 

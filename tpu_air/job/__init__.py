@@ -1,0 +1,17 @@
+"""tpu_air.job — headless job submission (the Anyscale-job-CLI analog).
+
+The reference packages W5 as a YAML job spec + CLI submit
+(flan-t5-batch-inference-job-setup.yml:1-7: name / compute_config /
+cluster_env / entrypoint; `anyscale job submit <yaml>`).  The TPU-native
+equivalent runs the entrypoint headless against a local slice: compute_config
+becomes the chip/CPU topology the job runtime initializes with.
+
+CLI:  python -m tpu_air.job submit <spec.yml> [--wait]
+      python -m tpu_air.job status <job_id>
+      python -m tpu_air.job logs <job_id>
+      python -m tpu_air.job list
+"""
+
+from .jobs import JobSpec, get_status, list_jobs, logs, submit, wait
+
+__all__ = ["JobSpec", "get_status", "list_jobs", "logs", "submit", "wait"]

@@ -1,0 +1,19 @@
+"""tpu_air.observability — dashboard, cluster state, profiling hooks.
+
+The reference stack promotes the Ray Dashboard at 127.0.0.1:8265 as "a vital
+observability tool" (Model_finetuning…ipynb:cc-9; Install_locally.md:64-67).
+The TPU-native equivalent is a JSON status service + prometheus text
+endpoint over the driver runtime's live state (SURVEY.md §2B dashboard row,
+§5 tracing notes).
+"""
+
+from .dashboard import start_dashboard, stop_dashboard, snapshot
+from .profiler import profile_trace, step_timer
+
+__all__ = [
+    "profile_trace",
+    "snapshot",
+    "start_dashboard",
+    "step_timer",
+    "stop_dashboard",
+]

@@ -1,0 +1,188 @@
+"""Dashboard: HTTP status service over the driver runtime.
+
+Endpoints (default 127.0.0.1:8265, the reference's dashboard address —
+Install_locally.md:64-67):
+  /                 tiny HTML overview
+  /api/cluster      resources, workers, actors, queue depth
+  /api/objects      object-store + arena stats
+  /api/version      framework version
+  /metrics          prometheus text exposition of the cluster gauges
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+
+def snapshot() -> Dict[str, Any]:
+    """Point-in-time cluster state (the /api/cluster payload)."""
+    from tpu_air.core import runtime as rt_mod
+
+    if not rt_mod.is_initialized():
+        return {"initialized": False}
+    rt = rt_mod.get_runtime()
+    with rt.lock:
+        workers = {
+            wid: {
+                "pid": ws.proc.pid,
+                "alive": ws.alive,
+                "actor_id": ws.actor_id,
+                "busy_task": ws.busy_task,
+            }
+            for wid, ws in rt.workers.items()
+        }
+        actors = {
+            aid: {
+                "name": st.name,
+                "worker_id": st.worker.worker_id,
+                "chip_ids": list(st.chip_ids),
+                "dead": st.dead,
+                "pending": st.pending,
+            }
+            for aid, st in rt.actors.items()
+        }
+        out = {
+            "initialized": True,
+            "session_id": rt.session_id,
+            "resources": {"cpu": rt.num_cpus, "chip": rt.num_chips},
+            "available": dict(rt.avail),
+            "free_chips": list(rt.free_chips),
+            "queue_depth": len(rt.queue),
+            "workers": workers,
+            "actors": actors,
+        }
+    return out
+
+
+def object_stats() -> Dict[str, Any]:
+    import os
+
+    from tpu_air.core import runtime as rt_mod
+
+    if not rt_mod.is_initialized():
+        return {"initialized": False}
+    rt = rt_mod.get_runtime()
+    files = 0
+    file_bytes = 0
+    try:
+        for name in os.listdir(rt.store_root):
+            if name.startswith((".", "__")):
+                continue
+            files += 1
+            file_bytes += os.path.getsize(os.path.join(rt.store_root, name))
+    except OSError:
+        pass
+    out: Dict[str, Any] = {
+        "store_root": rt.store_root,
+        "file_objects": files,
+        "file_bytes": file_bytes,
+    }
+    if rt.store._arena is not None:
+        out["arena"] = rt.store._arena.stats()
+    return out
+
+
+def _prometheus_text() -> str:
+    snap = snapshot()
+    lines = []
+    if snap.get("initialized"):
+        lines += [
+            f"tpu_air_cpus_total {snap['resources']['cpu']}",
+            f"tpu_air_chips_total {snap['resources']['chip']}",
+            f"tpu_air_cpus_available {snap['available'].get('cpu', 0)}",
+            f"tpu_air_chips_available {snap['available'].get('chip', 0)}",
+            f"tpu_air_queue_depth {snap['queue_depth']}",
+            f"tpu_air_workers {len(snap['workers'])}",
+            f"tpu_air_actors {len(snap['actors'])}",
+        ]
+        ost = object_stats()
+        lines.append(f"tpu_air_store_file_objects {ost.get('file_objects', 0)}")
+        lines.append(f"tpu_air_store_file_bytes {ost.get('file_bytes', 0)}")
+        if "arena" in ost:
+            for k, v in ost["arena"].items():
+                lines.append(f"tpu_air_arena_{k} {v}")
+    return "\n".join(lines) + "\n"
+
+
+_INDEX_HTML = """<!doctype html><html><head><title>tpu_air dashboard</title></head>
+<body><h2>tpu_air dashboard</h2>
+<p>JSON endpoints: <a href="/api/cluster">/api/cluster</a> ·
+<a href="/api/objects">/api/objects</a> ·
+<a href="/api/version">/api/version</a> ·
+<a href="/metrics">/metrics</a></p>
+<pre id="s"></pre>
+<script>
+async function load(){
+  const r = await fetch('/api/cluster');
+  document.getElementById('s').textContent = JSON.stringify(await r.json(), null, 2);
+}
+load(); setInterval(load, 2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = self.path.split("?")[0].rstrip("/") or "/"
+        try:
+            if path == "/":
+                self._send(200, _INDEX_HTML.encode(), "text/html")
+            elif path == "/api/cluster":
+                self._send(200, json.dumps(snapshot()).encode(), "application/json")
+            elif path == "/api/objects":
+                self._send(200, json.dumps(object_stats()).encode(), "application/json")
+            elif path == "/api/version":
+                import tpu_air
+
+                self._send(
+                    200,
+                    json.dumps({"version": tpu_air.__version__}).encode(),
+                    "application/json",
+                )
+            elif path == "/metrics":
+                self._send(200, _prometheus_text().encode(), "text/plain")
+            else:
+                self._send(404, b'{"error": "not found"}', "application/json")
+        except Exception as e:  # noqa: BLE001 — surface to the client
+            self._send(500, json.dumps({"error": str(e)}).encode(), "application/json")
+
+
+_server: Optional[ThreadingHTTPServer] = None
+_thread: Optional[threading.Thread] = None
+_lock = threading.Lock()
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> str:
+    """Start the dashboard; returns its URL (printed by init, like the
+    reference's 'Follow the link … to open the Ray Dashboard')."""
+    global _server, _thread
+    with _lock:
+        if _server is not None:
+            return f"http://{_server.server_address[0]}:{_server.server_address[1]}"
+        srv = ThreadingHTTPServer((host, port), _Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        _server, _thread = srv, t
+        return f"http://{host}:{srv.server_address[1]}"
+
+
+def stop_dashboard() -> None:
+    global _server, _thread
+    with _lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+            _server = None
+            _thread = None

@@ -1,0 +1,64 @@
+"""Profiling hooks (SURVEY.md §5 tracing: "per-step timing in the trainer
+loop, JAX profiler hooks (xplane traces)").
+
+* ``step_timer`` — lightweight wall/step accounting used by the trainer loops
+  (the reference's only in-repo tracing is %%time cells and time.time deltas,
+  Overview_of_Ray.ipynb:cc-18,24,47 — this is the structured version).
+* ``profile_trace`` — context manager around ``jax.profiler.trace`` producing
+  xplane/perfetto traces viewable in TensorBoard or ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+
+class step_timer:
+    """Accumulates per-step wall times; cheap enough for every train step.
+
+    >>> t = step_timer()
+    >>> with t.step():  # around each train_step
+    ...     ...
+    >>> t.summary()  # {'steps': N, 'mean_s': ..., 'p50_s': ..., 'p95_s': ...}
+    """
+
+    def __init__(self):
+        self.durations: list = []
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.durations.append(time.perf_counter() - t0)
+
+    def summary(self) -> Dict[str, Any]:
+        if not self.durations:
+            return {"steps": 0}
+        d = sorted(self.durations)
+        n = len(d)
+        return {
+            "steps": n,
+            "total_s": sum(d),
+            "mean_s": sum(d) / n,
+            "p50_s": d[n // 2],
+            "p95_s": d[min(n - 1, int(n * 0.95))],
+            "max_s": d[-1],
+        }
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str, host_tracer_level: Optional[int] = None) -> Iterator[None]:
+    """JAX xplane trace around a region — open the resulting directory in
+    TensorBoard's profile plugin (tensorboardX is in the pinned stack,
+    requirements.txt:156-equivalent)."""
+    import jax
+
+    opts = {}
+    if host_tracer_level is not None:
+        opts["host_tracer_level"] = host_tracer_level
+    with jax.profiler.trace(log_dir, **opts):
+        yield

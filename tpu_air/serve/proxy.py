@@ -138,47 +138,41 @@ def run(
                     f"serve proxy already running on port {_state.port}; "
                     f"cannot also listen on {port} (call serve.shutdown() first)"
                 )
-            old = _state.routes.get(prefix)
-            _state.routes[prefix] = handle
             if _state.server is None:
+                # bind before touching routes: a failed bind (EADDRINUSE)
+                # must not leave a route pointing at soon-dead replicas
                 server = ThreadingHTTPServer((host, port), _Handler)
                 thread = threading.Thread(target=server.serve_forever, daemon=True)
                 thread.start()
                 _state.server, _state.thread, _state.port = server, thread, port
+            old = _state.routes.get(prefix)
+            _state.routes[prefix] = handle
     except Exception:
-        # deployment failed after replicas started — retire them
-        from tpu_air.core.remote import kill
-
-        for replica in handle._replicas:
-            try:
-                kill(replica)
-            except Exception:
-                pass
+        _retire(handle)  # deployment failed after replicas started
         raise
     if old is not None:
         # Redeploy on an existing route: retire the previous deployment's
         # replicas so their actor processes and chip leases are released.
-        from tpu_air.core.remote import kill
-
-        for replica in old._replicas:
-            try:
-                kill(replica)
-            except Exception:
-                pass
+        _retire(old)
     return handle
+
+
+def _retire(handle: DeploymentHandle) -> None:
+    """Kill a deployment's replica actors (releases processes + chip leases)."""
+    from tpu_air.core.remote import kill
+
+    for replica in handle._replicas:
+        try:
+            kill(replica)
+        except Exception:
+            pass
 
 
 def shutdown() -> None:
     """Stop the proxy and kill every replica actor."""
-    from tpu_air.core.remote import kill
-
     with _state.lock:
         for handle in _state.routes.values():
-            for replica in handle._replicas:
-                try:
-                    kill(replica)
-                except Exception:
-                    pass
+            _retire(handle)
         _state.routes.clear()
         if _state.server is not None:
             _state.server.shutdown()
