@@ -36,6 +36,10 @@ class T5Config:
     # dtype policy: bf16 activations on TPU (fp16-on-GPU analog of
     # Model_finetuning…ipynb:cc-64), fp32 params.
     dtype: str = "float32"
+    # Pallas blockwise attention (ops/flash_attention.py) for non-decode
+    # paths; falls back to the XLA einsum path when attention dropout is
+    # active or during cached decode.
+    use_flash_attention: bool = False
 
     def __post_init__(self):
         if self.num_decoder_layers is None:

@@ -117,8 +117,10 @@ class Attention(nn.Module):
         self,
         hidden: Array,
         kv_hidden: Array,
-        mask: Optional[Array],           # [*, 1|heads, qlen, klen] additive
+        mask: Optional[Array],           # [*, 1|heads, qlen, klen] additive (dense)
         position_bias: Optional[Array],  # [1, heads, qlen, klen]
+        kv_mask: Optional[Array] = None,  # [batch, klen] 1=attend (structured)
+        causal: bool = False,             # structured causal flag
         decode: bool = False,
         deterministic: bool = True,
     ) -> Array:
@@ -152,15 +154,57 @@ class Attention(nn.Module):
                 idx.value = cur + q.shape[1]
                 k, v = ck.value, cv.value
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-        if position_bias is not None:
-            scores = scores + position_bias
-        if mask is not None:
-            scores = scores + mask
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
-        if not deterministic and cfg.dropout_rate > 0:
-            probs = nn.Dropout(cfg.dropout_rate)(probs, deterministic=False)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        qlen, klen = q.shape[1], k.shape[1]
+        # Pallas blockwise path: eligible when callers passed the structured
+        # mask form (causal flag + key-padding row — never a dense (q, k)
+        # tensor), we're not in cached decode (qlen == 1 per-token launches
+        # are a perf cliff; XLA's einsum path wins there), and attention
+        # dropout is inactive (flash streams probabilities — there is no
+        # materialized matrix to drop out of).
+        use_flash = (
+            cfg.use_flash_attention
+            and not decode
+            and qlen > 1
+            and mask is None
+            and (deterministic or cfg.dropout_rate == 0)
+        )
+        if use_flash:
+            from tpu_air.ops import flash_attention
+
+            # position_bias stays (1, H, q, k) — the kernel's BlockSpec
+            # replays the head tile per batch element; no HBM broadcast.
+            block = next(s for s in (128, 64, 32, 16, 8, 4, 2, 1) if qlen % s == 0)
+            kblock = next(s for s in (128, 64, 32, 16, 8, 4, 2, 1) if klen % s == 0)
+            ctx = flash_attention(
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                None if position_bias is None else position_bias.astype(jnp.float32),
+                kv_mask=kv_mask,
+                causal=causal,
+                scale=1.0,  # T5: unscaled scores
+                block_q=block,
+                block_k=kblock,
+            ).transpose(0, 2, 1, 3)
+        else:
+            if mask is None and (kv_mask is not None or causal):
+                # densify the structured mask for the einsum path
+                mask = jnp.zeros((1, 1, qlen, klen), jnp.float32)
+                if kv_mask is not None:
+                    mask = mask + (1.0 - kv_mask[:, None, None, :].astype(jnp.float32)) * NEG_INF
+                if causal:
+                    c = jnp.tril(jnp.ones((qlen, klen), jnp.float32))
+                    mask = mask + ((1.0 - c) * NEG_INF)[None, None]
+                mask = mask.astype(dtype)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+            if position_bias is not None:
+                scores = scores + position_bias
+            if mask is not None:
+                scores = scores + mask
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+            if not deterministic and cfg.dropout_rate > 0:
+                probs = nn.Dropout(cfg.dropout_rate)(probs, deterministic=False)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         return nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), use_bias=False, dtype=dtype,
             kernel_init=nn.initializers.normal(stddev=(cfg.num_heads * cfg.d_kv) ** -0.5),
@@ -198,11 +242,11 @@ class EncoderLayer(nn.Module):
     config: T5Config
 
     @nn.compact
-    def __call__(self, x, mask, position_bias, deterministic=True):
+    def __call__(self, x, kv_mask, position_bias, deterministic=True):
         cfg = self.config
         h = RMSNorm(cfg.layer_norm_epsilon, _dtype(cfg), name="ln_self")(x)
         x = x + Attention(cfg, name="self_attn")(
-            h, h, mask, position_bias, deterministic=deterministic
+            h, h, None, position_bias, kv_mask=kv_mask, deterministic=deterministic
         )
         h = RMSNorm(cfg.layer_norm_epsilon, _dtype(cfg), name="ln_mlp")(x)
         x = x + FeedForward(cfg, name="mlp")(h, deterministic=deterministic)
@@ -214,17 +258,19 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x, enc, self_mask, cross_mask, position_bias,
+        self, x, enc, position_bias, self_mask=None, self_kv_mask=None,
+        self_causal=False, cross_kv_mask=None,
         decode=False, deterministic=True,
     ):
         cfg = self.config
         h = RMSNorm(cfg.layer_norm_epsilon, _dtype(cfg), name="ln_self")(x)
         x = x + Attention(cfg, name="self_attn")(
-            h, h, self_mask, position_bias, decode=decode, deterministic=deterministic
+            h, h, self_mask, position_bias, kv_mask=self_kv_mask,
+            causal=self_causal, decode=decode, deterministic=deterministic,
         )
         h = RMSNorm(cfg.layer_norm_epsilon, _dtype(cfg), name="ln_cross")(x)
         x = x + Attention(cfg, name="cross_attn")(
-            h, enc, cross_mask, None, deterministic=deterministic
+            h, enc, None, None, kv_mask=cross_kv_mask, deterministic=deterministic
         )
         h = RMSNorm(cfg.layer_norm_epsilon, _dtype(cfg), name="ln_mlp")(x)
         x = x + FeedForward(cfg, name="mlp")(h, deterministic=deterministic)
@@ -242,10 +288,11 @@ class Encoder(nn.Module):
         bias = RelativePositionBias(cfg, bidirectional=True, name="rel_bias")(
             positions, positions
         )
-        mask = ((1.0 - attention_mask[:, None, None, :]) * NEG_INF).astype(_dtype(cfg))
         x = embeds
         for i in range(cfg.num_layers):
-            x = EncoderLayer(cfg, name=f"layer_{i}")(x, mask, bias, deterministic)
+            x = EncoderLayer(cfg, name=f"layer_{i}")(
+                x, attention_mask, bias, deterministic
+            )
         return RMSNorm(cfg.layer_norm_epsilon, _dtype(cfg), name="final_ln")(x)
 
 
@@ -287,11 +334,10 @@ class Decoder(nn.Module):
                 key_positions[None, :] <= query_positions[:, None]
             ).astype(jnp.float32)
             self_mask = ((1.0 - causal[None, None]) * NEG_INF).astype(dtype)
-            cross_mask = ((1.0 - enc_mask[:, None, None, :]) * NEG_INF).astype(dtype)
             x = embeds
             for i in range(cfg.num_decoder_layers):
                 x = DecoderLayer(cfg, name=f"layer_{i}")(
-                    x, enc, self_mask, cross_mask, bias,
+                    x, enc, bias, self_mask=self_mask, cross_kv_mask=enc_mask,
                     decode=True, deterministic=deterministic,
                 )
             pos.value = pos.value + qlen
@@ -301,16 +347,11 @@ class Decoder(nn.Module):
         bias = RelativePositionBias(cfg, bidirectional=False, name="rel_bias")(
             positions, positions
         )
-        causal = jnp.tril(jnp.ones((qlen, qlen), dtype=jnp.float32))
-        self_mask = causal[None, None]
-        if dec_mask is not None:
-            self_mask = self_mask * dec_mask[:, None, None, :]
-        self_mask = ((1.0 - self_mask) * NEG_INF).astype(dtype)
-        cross_mask = ((1.0 - enc_mask[:, None, None, :]) * NEG_INF).astype(dtype)
         x = embeds
         for i in range(cfg.num_decoder_layers):
             x = DecoderLayer(cfg, name=f"layer_{i}")(
-                x, enc, self_mask, cross_mask, bias,
+                x, enc, bias, self_kv_mask=dec_mask, self_causal=True,
+                cross_kv_mask=enc_mask,
                 decode=False, deterministic=deterministic,
             )
         return RMSNorm(cfg.layer_norm_epsilon, dtype, name="final_ln")(x)

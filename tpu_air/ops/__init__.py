@@ -1,0 +1,16 @@
+"""tpu_air.ops — Pallas TPU kernels + distributed attention primitives.
+
+The custom-kernel layer of the stack (SURVEY.md §2B: ATen/CUDA kernels →
+"XLA:TPU kernels via jit; Pallas for anything custom").  Long-context
+support (ring attention over a sequence mesh axis) lives here too.
+"""
+
+from .flash_attention import flash_attention, flash_attention_with_lse
+from .ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "ring_attention",
+    "ring_attention_sharded",
+]

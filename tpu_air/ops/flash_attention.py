@@ -1,0 +1,343 @@
+"""Flash attention — Pallas TPU kernel with blockwise online softmax.
+
+The hot op of the model layer (SURVEY.md §2B ATen row → "Pallas for anything
+custom").  Blockwise streaming over K/V keeps the (Lq, Lk) score matrix out
+of HBM: VMEM holds one (BQ, BK) tile at a time and the MXU sees back-to-back
+(BQ,D)x(D,BK) and (BQ,BK)x(BK,D) matmuls; running max/sum statistics ride in
+VMEM scratch across the sequentially-iterated k grid dimension (TPU grid
+order is row-major, so the innermost k axis revisits the same q tile's
+scratch).
+
+Broadcast-aware operands — the reason a stock kernel doesn't fit T5:
+* ``bias``: additive scores of shape (1|H|B·H, Lq, Lk).  T5's relative-
+  position bias is per-head but batch-shared (H, Lq, Lk); the BlockSpec
+  index map replays the same head tile for every batch element instead of
+  materializing a (B·H, Lq, Lk) array in HBM.
+* ``kv_mask``: per-batch key-padding mask (B, Lk), 1 = attend.  Expanded to
+  a (1, BK) additive tile inside VMEM, never an (Lq, Lk) matrix.
+* ``causal``: masking from block-local iota, zero HBM.
+
+f32 accumulation regardless of input dtype.  Backward is an XLA recompute of
+the reference attention (correct VJP for q/k/v/bias; the forward's HBM
+savings are where long-context wins live).  Both the attention output and
+the logsumexp are differentiable, so ring attention (ring_attention.py) can
+train through the merged stats.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------------
+# kernel
+# --------------------------------------------------------------------------
+
+
+def _kernel(q_ref, k_ref, v_ref, bias_ref, mask_ref, out_ref, lse_ref,
+            acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k):
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # (BQ, D)
+    k = k_ref[0].astype(jnp.float32)  # (BK, D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (BQ, BK)
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    if mask_ref is not None:
+        # (1, BK) additive key-padding row, broadcast over queries
+        s = s + mask_ref[0].astype(jnp.float32)
+    if causal:
+        i = pl.program_id(1)
+        qi = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kj = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(qi >= kj, s, _NEG_INF)
+
+    m_prev = m_ref[:, :1]  # (BQ, 1)
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)  # (BQ, BK)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → 0 output
+        out_ref[0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0]))
+
+
+def _kernel_nb(q, k, v, m, o, lse, acc, mr, lr, **kw):
+    _kernel(q, k, v, None, m, o, lse, acc, mr, lr, **kw)
+
+
+def _kernel_nm(q, k, v, b, o, lse, acc, mr, lr, **kw):
+    _kernel(q, k, v, b, None, o, lse, acc, mr, lr, **kw)
+
+
+def _kernel_nbm(q, k, v, o, lse, acc, mr, lr, **kw):
+    _kernel(q, k, v, None, None, o, lse, acc, mr, lr, **kw)
+
+
+def _bias_index_map(bias_b: int, bh: int):
+    if bias_b == bh:
+        return lambda b, i, j: (b, i, j)
+    if bias_b == 1:
+        return lambda b, i, j: (0, i, j)
+    if bh % bias_b == 0:
+        # per-head, batch-shared: grid b = batch*H + head, bias_b == H
+        return lambda b, i, j: (b % bias_b, i, j)
+    raise ValueError(f"bias leading dim {bias_b} incompatible with batch·heads {bh}")
+
+
+def _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k, interpret):
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"sequence lengths ({lq}, {lk}) must divide block sizes "
+            f"({block_q}, {block_k}); pad inputs first"
+        )
+    grid = (bh, lq // block_q, lk // block_k)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_q, block_k), _bias_index_map(bias.shape[0], bh))
+        )
+        args.append(bias)
+    if kv_mask is not None:
+        nb = kv_mask.shape[0]
+        if nb == 1:
+            mask_map = lambda b, i, j: (0, j)  # noqa: E731
+        else:
+            h_per = bh // nb
+            mask_map = lambda b, i, j: (b // h_per, j)  # noqa: E731
+        in_specs.append(pl.BlockSpec((1, block_k), mask_map))
+        args.append(kv_mask)
+
+    if bias is not None and kv_mask is not None:
+        kernel = _kernel
+    elif bias is not None:
+        kernel = _kernel_nm
+    elif kv_mask is not None:
+        kernel = _kernel_nb
+    else:
+        kernel = _kernel_nbm
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),    # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max (lane-bcast)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum (lane-bcast)
+        ],
+        interpret=interpret,
+    )(*args)
+    return out, lse
+
+
+# --------------------------------------------------------------------------
+# reference (oracle for tests; recompute target for the backward pass)
+# --------------------------------------------------------------------------
+
+
+def _expand_bias(bias, bh, lq, lk):
+    if bias is None:
+        return None
+    b0 = bias.shape[0]
+    if b0 == bh:
+        return bias
+    if b0 == 1:
+        return jnp.broadcast_to(bias, (bh, lq, lk))
+    reps = bh // b0
+    return jnp.broadcast_to(bias[None], (reps, b0, lq, lk)).reshape(bh, lq, lk)
+
+
+def _reference_pair(q, k, v, bias, kv_mask, scale, causal):
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    bias = _expand_bias(bias, bh, lq, lk)
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if kv_mask is not None:
+        h_per = bh // kv_mask.shape[0]
+        m = jnp.repeat(kv_mask.astype(jnp.float32), h_per, axis=0)  # (bh, lk)
+        s = s + m[:, None, :]
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        kj = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where(qi >= kj, s, _NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return out, lse
+
+
+def _reference_attention(q, k, v, bias, scale, causal, kv_mask=None):
+    return _reference_pair(q, k, v, bias, kv_mask, scale, causal)[0]
+
+
+# --------------------------------------------------------------------------
+# differentiable entry (custom VJP over both outputs)
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_pair(q, k, v, bias, kv_mask, scale, causal, block_q, block_k, interpret):
+    return _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k,
+                       interpret)
+
+
+def _flash_pair_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k,
+                    interpret):
+    out = _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k,
+                      interpret)
+    return out, (q, k, v, bias, kv_mask)
+
+
+def _flash_pair_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, bias, kv_mask = res
+
+    def f(q, k, v, bias):
+        return _reference_pair(q, k, v, bias, kv_mask, scale, causal)
+
+    _, vjp = jax.vjp(f, q, k, v, bias)
+    dq, dk, dv, dbias = vjp(g)
+    dmask = None if kv_mask is None else jnp.zeros_like(kv_mask)
+    return dq, dk, dv, dbias, dmask
+
+
+_flash_pair.defvjp(_flash_pair_fwd, _flash_pair_bwd)
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+
+def _normalize(q, k, v, bias):
+    """Accept (B, H, L, D) or (B·H, L, D); fold heads into batch."""
+    if q.ndim == 4:
+        b, h, lq, d = q.shape
+        q = q.reshape(b * h, lq, d)
+        k = k.reshape(b * h, k.shape[2], d)
+        v = v.reshape(b * h, v.shape[2], d)
+        if bias is not None:
+            if bias.ndim != 4:
+                raise ValueError("bias must be 4D when q/k/v are 4D")
+            bb, bh_, blq, blk = bias.shape
+            if bb == 1:
+                bias = bias.reshape(bh_, blq, blk)  # (H|1, Lq, Lk)
+            else:
+                bias = jnp.broadcast_to(bias, (b, h, blq, blk)).reshape(
+                    b * h, blq, blk
+                )
+        return q, k, v, bias, (b, h)
+    return q, k, v, bias, None
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    bias: Optional[jax.Array] = None,
+    *,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Blockwise attention.
+
+    q/k/v: (B·H, L, D) or (B, H, L, D).  bias: additive scores, leading dim
+    1, H, or B·H (T5 passes its (1, H, Lq, Lk) relative-position bias
+    directly — it is NOT expanded to batch size).  kv_mask: (B, Lk) with
+    1 = attend, 0 = masked (key padding).  scale defaults to 1/sqrt(D);
+    pass 1.0 for T5.  On non-TPU backends runs in Pallas interpret mode so
+    the same code path tests on the CPU mesh (SURVEY.md §4.3).
+    """
+    q, k, v, bias, fold = _normalize(q, k, v, bias)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    addmask = None
+    if kv_mask is not None:
+        addmask = (1.0 - kv_mask.astype(jnp.float32)) * _NEG_INF
+    out, _ = _flash_pair(q, k, v, bias, addmask, float(scale), bool(causal),
+                         block_q, block_k, bool(interpret))
+    if fold is not None:
+        b, h = fold
+        out = out.reshape(b, h, out.shape[1], out.shape[2])
+    return out
+
+
+def flash_attention_with_lse(
+    q, k, v, bias=None, *, kv_mask=None, scale=None, causal=False,
+    block_q: int = 128, block_k: int = 128, interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(out, logsumexp) variant — ring attention merges partial softmaxes
+    across devices with the lse.  Differentiable in both outputs."""
+    q, k, v, bias, fold = _normalize(q, k, v, bias)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    addmask = None
+    if kv_mask is not None:
+        addmask = (1.0 - kv_mask.astype(jnp.float32)) * _NEG_INF
+    out, lse = _flash_pair(q, k, v, bias, addmask, float(scale), bool(causal),
+                           block_q, block_k, bool(interpret))
+    if fold is not None:
+        b, h = fold
+        out = out.reshape(b, h, out.shape[1], out.shape[2])
+        lse = lse.reshape(b, h, lse.shape[1])
+    return out, lse
