@@ -1,0 +1,29 @@
+"""Shared host-environment insulation for the repo-root harness scripts.
+
+This environment's sitecustomize registers a real-TPU PJRT plugin (gated on
+``PALLAS_AXON_POOL_IPS``) that can wedge or fail CPU-mesh runs even under
+``JAX_PLATFORMS=cpu``.  ``bench.py`` and ``__graft_entry__.py`` both need a
+clean CPU subprocess environment; the recipe lives here once.
+(tests/conftest.py keeps its own self-contained copy because it must run
+before anything else is importable.)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def cpu_env(n_devices: int | None = None) -> dict:
+    """A copy of os.environ with the TPU plugin disabled and XLA:CPU forced;
+    with ``n_devices`` an n-device virtual host-platform mesh is requested."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize gate for TPU plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    xla = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", env.get("XLA_FLAGS", "")
+    ).strip()
+    if n_devices is not None:
+        xla = f"{xla} --xla_force_host_platform_device_count={n_devices}".strip()
+    env["XLA_FLAGS"] = xla
+    return env

@@ -1,57 +1,76 @@
-"""Benchmark harness: FLAN-T5 fine-tune throughput, tokens/sec/chip.
+"""Benchmark harness: FLAN-T5 fine-tune throughput, tokens/sec/chip + MFU.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+"platform": ..., "mfu": ..., ...}.
 
-The reference publishes no comparable number (BASELINE.md — teaching workshop,
-`published: {}`), so vs_baseline is measured against the reference's workshop
-setup contract instead: FLAN-T5 fine-tune with the notebook's hyperparameters
-(per-device batch 2+, seq 512 — Model_finetuning…ipynb:cc-26,32) must sustain
-real training throughput on one chip; vs_baseline reports value / the last
-recorded run when BENCH_LAST.json exists, else 1.0.
+Robustness contract (VERDICT r1 item 1): the injected `axon` PJRT plugin can
+fail TPU backend init with UNAVAILABLE, and a wedged init must not lose the
+round's perf artifact.  The parent process therefore never imports jax; it
+runs the measurement in a child subprocess — TPU attempt, one retry, then a
+CPU-smoke fallback with the plugin disabled — and ALWAYS exits 0 with a JSON
+line describing whichever attempt succeeded.
+
+The measured workload is the reference's W1 fine-tune contract (seq 512,
+per-device batch >= 2 — Model_finetuning_and_batch_inference.ipynb:cc-26,32)
+in the config we actually ship on TPU: bf16 activations.  Both the XLA einsum
+attention path and the Pallas flash-attention path are measured; the faster
+one is the headline number and both appear in the JSON.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LAST_PATH = os.path.join(_HERE, "BENCH_LAST.json")
 
-def main() -> None:
+# bf16 peak FLOPs/s per chip by PJRT device_kind (public spec sheets).
+_PEAK_FLOPS = {
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,
+}
+
+
+def _peak_flops(device_kind: str):
+    for k, v in sorted(_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if device_kind.startswith(k):
+            return v
+    return None
+
+
+def _count_params(tree) -> int:
+    import jax
+
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def _measure_throughput(model, config, params0, batch, enc_len, dec_len, steps, warmup):
+    """Time `steps` donated-jit train steps; returns (tokens/sec, loss)."""
     import jax
     import jax.numpy as jnp
     import optax
     from functools import partial
 
-    from tpu_air.models.t5 import (
-        T5Config,
-        T5ForConditionalGeneration,
-        cross_entropy_loss,
-        shift_right,
-    )
-
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
-
-    if on_tpu:
-        config = T5Config.flan_t5_base()
-        batch, enc_len, dec_len = 32, 512, 128
-        steps, warmup = 10, 2
-    else:  # CPU smoke mode — same path, tiny dials (SURVEY.md §4.2)
-        config = T5Config.tiny()
-        batch, enc_len, dec_len = 8, 64, 16
-        steps, warmup = 4, 1
-    config.dropout_rate = 0.0
-    config.dtype = "bfloat16" if on_tpu else "float32"
-
-    model = T5ForConditionalGeneration(config)
     pad, start = config.pad_token_id, config.decoder_start_token_id
     rng = jax.random.PRNGKey(0)
     input_ids = jax.random.randint(rng, (batch, enc_len), 2, config.vocab_size, jnp.int32)
     attention_mask = jnp.ones((batch, enc_len), jnp.int32)
     labels = jax.random.randint(rng, (batch, dec_len), 2, config.vocab_size, jnp.int32)
 
-    params = model.init(rng, input_ids[:1, :8], attention_mask[:1, :8], labels[:1, :4])["params"]
+    from tpu_air.models.t5 import cross_entropy_loss, shift_right
+
+    params = jax.tree_util.tree_map(jnp.copy, params0)
     tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(2e-5, weight_decay=0.01))
     opt_state = tx.init(params)
 
@@ -82,34 +101,185 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     tokens_per_step = batch * (enc_len + dec_len)
-    value = tokens_per_step * steps / dt
+    return tokens_per_step * steps / dt, float(loss)
+
+
+def _child_main() -> None:
+    import jax
+
+    from tpu_air.models.t5 import T5Config, T5ForConditionalGeneration
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        config = T5Config.flan_t5_base()
+        batch, enc_len, dec_len = 32, 512, 128
+        steps, warmup = 10, 2
+    else:  # CPU smoke mode — same path, tiny dials (SURVEY.md §4.2)
+        config = T5Config.tiny()
+        batch, enc_len, dec_len = 8, 64, 16
+        steps, warmup = 4, 1
+    config.dropout_rate = 0.0
+    config.dtype = "bfloat16" if on_tpu else "float32"
+
+    import jax.numpy as jnp
+
+    model = T5ForConditionalGeneration(config)
+    rng = jax.random.PRNGKey(0)
+    init_ids = jnp.ones((1, 8), jnp.int32)
+    params = model.init(rng, init_ids, jnp.ones((1, 8), jnp.int32), jnp.ones((1, 4), jnp.int32))["params"]
+    n_params = _count_params(params)
+
+    results = {}
+    losses = {}
+    # einsum path (XLA attention)
+    tps, loss = _measure_throughput(model, config, params, batch, enc_len, dec_len, steps, warmup)
+    results["einsum"], losses["einsum"] = tps, loss
+    # flash path (Pallas kernel) — only meaningful where the kernel runs (TPU)
+    if on_tpu:
+        try:
+            flash_config = T5Config.from_dict({**config.to_dict(), "use_flash_attention": True})
+            flash_model = T5ForConditionalGeneration(flash_config)
+            tps_f, loss_f = _measure_throughput(flash_model, flash_config, params, batch, enc_len, dec_len, steps, warmup)
+            results["flash"], losses["flash"] = tps_f, loss_f
+        except Exception as e:  # a broken kernel must not kill the bench
+            print(f"flash-attention path failed: {type(e).__name__}: {e}", file=sys.stderr)
+
+    best_path = max(results, key=results.get)
+    value = results[best_path]
+    loss = losses[best_path]
+
+    # Training-step FLOPs estimate: fwd+bwd ~= 6 * n_params * tokens
+    # (standard dense-transformer accounting; attention score FLOPs omitted).
+    flops_per_step = 6.0 * n_params * batch * (enc_len + dec_len)
+    peak = _peak_flops(dev.device_kind) if on_tpu else None
+    tokens_per_step = batch * (enc_len + dec_len)
+    mfu = (value / tokens_per_step) * flops_per_step / peak if peak else None
 
     metric = f"flan-t5-{'base' if on_tpu else 'tiny'} fine-tune throughput ({platform})"
     vs_baseline = 1.0
-    last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST.json")
-    try:
-        with open(last_path) as f:
-            prev = json.load(f)
-        # only comparable if the previous run measured the same metric
-        # (model size + platform are encoded in the metric string)
-        if prev.get("metric") == metric and prev.get("value"):
-            vs_baseline = value / float(prev["value"])
-    except Exception:
-        pass
+    prev = _load_last().get(metric)
+    if prev and prev.get("value"):
+        # only comparable against the same metric (model size + platform are
+        # encoded in the metric string) — a CPU-fallback round must not
+        # clobber the comparison for the next TPU round
+        vs_baseline = value / float(prev["value"])
 
     result = {
         "metric": metric,
         "value": round(value, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 3),
+        "platform": platform,
+        "device_kind": dev.device_kind,
+        "n_params": n_params,
+        "attention_path": best_path,
+        "tokens_per_sec": {k: round(v, 2) for k, v in results.items()},
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "batch": batch,
+        "enc_len": enc_len,
+        "dec_len": dec_len,
+        "dtype": config.dtype,
+        "final_loss": round(loss, 4),
     }
+    print(json.dumps(result), flush=True)
+
+
+def _load_last() -> dict:
+    """BENCH_LAST.json holds {metric: result} so runs on different
+    platforms/model sizes never overwrite each other's baseline."""
     try:
-        with open(last_path, "w") as f:
-            json.dump(result, f)
+        with open(_LAST_PATH) as f:
+            prev = json.load(f)
     except Exception:
-        pass
-    print(json.dumps(result))
+        return {}
+    if isinstance(prev, dict) and "metric" in prev:  # legacy flat format
+        return {prev["metric"]: prev}
+    return prev if isinstance(prev, dict) else {}
+
+
+def _run_child(env: dict, timeout: float):
+    """Run the measurement subprocess; return the parsed JSON result or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, cwd=_HERE, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print("bench child timed out", file=sys.stderr)
+        return None
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-4000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    if proc.returncode != 0:
+        print(f"bench child rc={proc.returncode}", file=sys.stderr)
+    return None
+
+
+def _cpu_env() -> dict:
+    from _hostenv import cpu_env
+
+    return cpu_env()
+
+
+def _probe_backend(env: dict, timeout: float) -> bool:
+    """Cheap check that jax backend init completes (the axon plugin can hang
+    for minutes rather than failing fast — probe before committing to a full
+    measurement run)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> None:
+    probe_timeout = float(os.environ.get("TPU_AIR_BENCH_PROBE_TIMEOUT", "240"))
+    run_timeout = float(os.environ.get("TPU_AIR_BENCH_TIMEOUT", "1800"))
+    result = None
+    # attempt 1+2: whatever backend the environment resolves (TPU when live),
+    # gated on a short backend-init probe so a wedged tunnel can't eat the round
+    for _ in range(2):
+        if _probe_backend(dict(os.environ), timeout=probe_timeout):
+            result = _run_child(dict(os.environ), timeout=run_timeout)
+            if result:
+                break
+    # fallback: CPU smoke with the TPU plugin disabled — never lose the artifact
+    if not result:
+        result = _run_child(_cpu_env(), timeout=900)
+    if not result:
+        result = {
+            "metric": "bench-harness-failure",
+            "value": 0.0,
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0,
+            "platform": "none",
+        }
+    else:
+        # record per-metric so a fallback run never destroys a TPU baseline
+        try:
+            last = _load_last()
+            last[result["metric"]] = result
+            with open(_LAST_PATH, "w") as f:
+                json.dump(last, f)
+        except Exception:
+            pass
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        _child_main()
+    else:
+        main()

@@ -23,6 +23,10 @@ def _want_env() -> dict:
     xla = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in xla:
         xla = f"{xla} {_HOST_DEVICES_FLAG}".strip()
+    if "xla_backend_optimization_level" not in xla:
+        # tests are compile-bound, not FLOP-bound: O0 cuts XLA:CPU compile
+        # time ~40% with identical semantics (worker subprocesses inherit it)
+        xla = f"{xla} --xla_backend_optimization_level=0".strip()
     return {
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": xla,

@@ -195,18 +195,22 @@ def test_ring_attention_gradients(qkv):
 def test_t5_flash_decode_uses_einsum_path(monkeypatch):
     """Cached decode must never launch the Pallas kernel (per-token qlen=1
     launches are the perf cliff the config docstring promises to avoid)."""
-    import dataclasses
+    import importlib
 
-    import tpu_air.ops.flash_attention as fa
+    # NB: `import tpu_air.ops.flash_attention as fa` would bind the *function*
+    # (the `from .flash_attention import flash_attention` re-export in
+    # ops/__init__.py shadows the submodule attribute of the same name), so
+    # resolve the module explicitly.
+    fa = importlib.import_module("tpu_air.ops.flash_attention")
     from tpu_air.models.t5 import T5Config, T5ForConditionalGeneration
     from tpu_air.models.t5.generate import generate
 
-    calls = {"n": 0}
+    qlens = []
     orig = fa._pallas_fwd
 
-    def counting(*a, **kw):
-        calls["n"] += 1
-        return orig(*a, **kw)
+    def counting(q, *a, **kw):
+        qlens.append(q.shape[1])
+        return orig(q, *a, **kw)
 
     monkeypatch.setattr(fa, "_pallas_fwd", counting)
     cfg = T5Config.tiny()
@@ -217,10 +221,15 @@ def test_t5_flash_decode_uses_einsum_path(monkeypatch):
     ii = jax.random.randint(rng, (1, 16), 2, cfg.vocab_size, jnp.int32)
     am = jnp.ones((1, 16), jnp.int32)
     params = model.init(rng, ii, am, ii[:, :4])["params"]
-    calls["n"] = 0
+    qlens.clear()
     seqs = generate(model, params, np.asarray(ii), attention_mask=np.asarray(am),
                     max_new_tokens=4)
     assert seqs.shape[0] == 1
-    # the encoder runs flash (one call per encoder layer); the decode loop
-    # must contribute zero additional kernel launches
-    assert calls["n"] <= cfg.num_layers, f"flash ran in decode: {calls['n']} calls"
+    # The encoder traces flash once per layer (qlen=16); init_cache's
+    # eval_shape additionally traces decoder cross-attention at the full
+    # decode budget (qlen=5, costless — abstract trace only).  The contract:
+    # no per-token qlen=1 launch may ever reach the kernel — that is the perf
+    # cliff the config docstring promises to avoid, and it is exactly what
+    # the lax.scan decode body would produce if the gating regressed.
+    assert qlens, "flash never ran (encoder path should trace it)"
+    assert all(q > 1 for q in qlens), f"flash ran with per-token qlen=1: {qlens}"

@@ -51,6 +51,7 @@ def torch_pair():
     return torch_model, model, variables
 
 
+@pytest.mark.slow
 def test_forward_matches_torch(torch_pair):
     import torch
 
@@ -95,6 +96,7 @@ def test_segmentation_loss_masks_ignore_index():
     assert loss > 0.0 and np.isfinite(loss)
 
 
+@pytest.mark.slow
 def test_segmentation_loss_matches_torch_ce(torch_pair):
     torch = pytest.importorskip("torch")
     import torch.nn.functional as F

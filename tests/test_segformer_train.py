@@ -9,6 +9,8 @@ import pandas as pd
 import pytest
 
 import tpu_air
+
+pytestmark = pytest.mark.slow
 from tpu_air import data as tad
 from tpu_air.data import BatchMapper
 from tpu_air.models.segformer import (

@@ -125,6 +125,7 @@ def torch_pair():
     return torch_model, model, params
 
 
+@pytest.mark.slow
 def test_forward_parity_with_torch(torch_pair):
     import torch
 
@@ -153,6 +154,7 @@ def test_forward_parity_with_torch(torch_pair):
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_generate_parity_with_torch(torch_pair):
     import torch
 

@@ -227,6 +227,44 @@ def test_tuner_w8_gbdt(air):
     assert 2 <= best.config["params"]["max_depth"] < 5
 
 
+def test_gbdt_asha_prune_saves_rounds(air):
+    """A pruned GBDT trial must provably fit fewer boosting rounds than
+    num_boost_round (warm_start incremental fit — VERDICT r1 item 9), not
+    replay staged predictions after a full fit."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(80, 3)
+    y = (X[:, 0] > 0).astype(int)
+    rows = [{"a": float(a), "b": float(b), "c": float(c), "label": int(t)}
+            for (a, b, c), t in zip(X, y)]
+    ds = tad.from_items(rows)
+    train_ds, valid_ds = ds.train_test_split(0.25)
+    rounds = 12
+    trainer = GBDTTrainer(
+        label_column="label",
+        params={"objective": "binary:logistic", "max_depth": 3},
+        num_boost_round=rounds,
+        datasets={"train": train_ds, "valid": valid_ds},
+    )
+    grid = tune.Tuner(
+        trainer,
+        # one sane eta and one hopeless one — ASHA must cut the loser early
+        param_space={"params": {"eta": tune.grid_search([0.3, 1e-6])}},
+        tune_config=tune.TuneConfig(
+            metric="valid-logloss", mode="min", num_samples=1, seed=3,
+            scheduler=tune.ASHAScheduler(max_t=rounds, grace_period=2,
+                                         reduction_factor=2),
+        ),
+    ).fit()
+    assert len(grid) == 2
+    iters = sorted(r.metrics.get("iteration", 0) for r in grid)
+    assert iters[-1] == rounds, "at least one survivor runs to completion"
+    assert iters[0] < rounds, "ASHA never pruned — incremental fit unproven"
+    # the pruned trial's checkpoint holds exactly the rounds it fit
+    pruned = min(grid, key=lambda r: r.metrics.get("iteration", 0))
+    extras = pruned.checkpoint._load_extras()
+    assert extras["rounds_fit"] == pruned.metrics["iteration"] < rounds
+
+
 # -- review-driven regressions ------------------------------------------------
 
 def test_grid_times_num_samples(air):

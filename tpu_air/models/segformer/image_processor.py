@@ -50,6 +50,14 @@ def _resize(img: np.ndarray, h: int, w: int, nearest: bool) -> np.ndarray:
     return np.asarray(Image.fromarray(img).resize((w, h), mode))
 
 
+def _resize_int32(lbl: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Nearest-neighbor resize for label maps with class ids > 255
+    (PIL mode 'I' keeps int32 exact under NEAREST)."""
+    from PIL import Image
+
+    return np.asarray(Image.fromarray(lbl, mode="I").resize((w, h), Image.NEAREST))
+
+
 class SegformerImageProcessor:
     """Resize → rescale → normalize images; resize(nearest) → reduce labels."""
 
@@ -105,7 +113,13 @@ class SegformerImageProcessor:
             lbl = lbl.astype(np.int32)
             lbl = np.where(lbl == 0, 255, lbl - 1)
         if self.do_resize:
-            lbl = _resize(lbl.astype(np.uint8), self.size[0], self.size[1], nearest=True)
+            # uint8 is enough for ADE20K (150 classes + ignore=255) but
+            # truncates ids > 255 — keep int32 through the resize then
+            lbl = np.asarray(lbl)
+            if lbl.max(initial=0) < 256:
+                lbl = _resize(lbl.astype(np.uint8), self.size[0], self.size[1], nearest=True)
+            else:
+                lbl = _resize_int32(lbl.astype(np.int32), self.size[0], self.size[1])
         return lbl.astype(np.int32)
 
     # -- batch entry point --------------------------------------------------

@@ -116,12 +116,21 @@ class ByteTokenizer:
 
 
 def auto_tokenizer(name_or_path: str):
-    """Best-effort tokenizer resolution: HF fast tokenizer when available
-    locally (predictor.py:64 defaults to AutoTokenizer), else ByteTokenizer."""
+    """Best-effort tokenizer resolution (predictor.py:64 defaults to
+    AutoTokenizer): HF fast tokenizer when its assets resolve locally, else
+    the framework's pure-Python sentencepiece unigram loader for on-disk
+    ``spiece.model``/``tokenizer.json`` (real FLAN-T5 vocab, offline), else
+    ByteTokenizer."""
     try:
         from transformers import AutoTokenizer
 
         return AutoTokenizer.from_pretrained(name_or_path)
+    except Exception:
+        pass
+    try:
+        from .sentencepiece_unigram import T5SentencePieceTokenizer
+
+        return T5SentencePieceTokenizer.from_pretrained(name_or_path)
     except Exception:
         if os.path.isdir(name_or_path):
             return ByteTokenizer.from_pretrained(name_or_path)

@@ -59,6 +59,12 @@ def profile_trace(log_dir: str, host_tracer_level: Optional[int] = None) -> Iter
 
     opts = {}
     if host_tracer_level is not None:
-        opts["host_tracer_level"] = host_tracer_level
+        # jax>=0.4.x takes tracer levels via ProfileOptions, not a kwarg
+        try:
+            po = jax.profiler.ProfileOptions()
+            po.host_tracer_level = host_tracer_level
+            opts["profiler_options"] = po
+        except AttributeError:  # older jax: legacy kwarg
+            opts["host_tracer_level"] = host_tracer_level
     with jax.profiler.trace(log_dir, **opts):
         yield

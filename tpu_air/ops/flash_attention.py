@@ -85,7 +85,11 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, mask_ref, out_ref, lse_ref,
     @pl.when(j == nk - 1)
     def _finalize():
         l = l_ref[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → 0 output
+        # NB: masking uses finite -1e30, so a fully-masked row has p=exp(0)=1
+        # per entry and l == klen, never 0 — such rows yield mean(V), matching
+        # the dense softmax reference path.  The guard below only protects
+        # against division by zero for degenerate zero-length tiles.
+        safe_l = jnp.where(l == 0.0, 1.0, l)
         out_ref[0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
         lse_ref[0] = m_ref[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0]))
 
