@@ -55,12 +55,44 @@ def _count_params(tree) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
 
 
-def _measure_throughput(model, config, params0, batch, enc_len, dec_len, steps, warmup):
-    """Time `steps` donated-jit train steps; returns (tokens/sec, loss)."""
+def _dispatch_overhead():
+    """Median host->device->host round trip for a trivial jitted op.
+
+    Under the axon PJRT tunnel a dispatch costs ~70ms of wire latency and
+    jax.block_until_ready is NOT a reliable sync point (measured: a chained
+    matmul loop "finished" at 33,000 TFLOP/s).  Only a host transfer
+    (float(x)) actually waits for the device.  We measure that fixed cost so
+    the step timing can subtract it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def tiny(a):
+        return a + 1.0
+
+    a = jnp.zeros(())
+    float(tiny(a))  # compile
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(tiny(a))
+        samples.append(time.perf_counter() - t0)
+    return sorted(samples)[len(samples) // 2]
+
+
+def _measure_throughput(model, config, params0, batch, enc_len, dec_len, steps):
+    """Time `steps` train steps run inside ONE compiled lax.scan dispatch,
+    synced by a host transfer of the final loss; returns (tokens/sec, loss).
+
+    A per-step Python loop would measure dispatch latency, not device
+    throughput (block_until_ready is a no-op under the axon tunnel — see
+    _dispatch_overhead); the scan form is also the honest TPU idiom: the
+    whole measured region is one XLA program.
+    """
     import jax
     import jax.numpy as jnp
     import optax
-    from functools import partial
 
     pad, start = config.pad_token_id, config.decoder_start_token_id
     rng = jax.random.PRNGKey(0)
@@ -74,8 +106,9 @@ def _measure_throughput(model, config, params0, batch, enc_len, dec_len, steps, 
     tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(2e-5, weight_decay=0.01))
     opt_state = tx.init(params)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(p, o, input_ids, attention_mask, labels):
+    def train_step(carry, _):
+        p, o = carry
+
         def loss_fn(pp):
             dec_in = shift_right(labels, start, pad)
             dec_mask = (dec_in != pad).astype(jnp.int32).at[:, 0].set(1)
@@ -88,20 +121,26 @@ def _measure_throughput(model, config, params0, batch, enc_len, dec_len, steps, 
 
         loss, grads = jax.value_and_grad(loss_fn)(p)
         updates, o = tx.update(grads, o, p)
-        return optax.apply_updates(p, updates), o, loss
+        return (optax.apply_updates(p, updates), o), loss
 
-    for _ in range(warmup):
-        params, opt_state, loss = train_step(params, opt_state, input_ids, attention_mask, labels)
-    jax.block_until_ready(loss)
+    @jax.jit
+    def run_steps(p, o):
+        (p, o), losses = jax.lax.scan(train_step, (p, o), None, length=steps)
+        return p, o, losses[-1]
+
+    overhead = _dispatch_overhead()
+
+    # compile + warm up (the first transfer also faults in any lazy state)
+    params, opt_state, loss = run_steps(params, opt_state)
+    _ = float(loss)
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = train_step(params, opt_state, input_ids, attention_mask, labels)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    params, opt_state, loss = run_steps(params, opt_state)
+    loss = float(loss)  # host transfer = the only reliable sync point
+    dt = max(time.perf_counter() - t0 - overhead, 1e-9)
 
     tokens_per_step = batch * (enc_len + dec_len)
-    return tokens_per_step * steps / dt, float(loss)
+    return tokens_per_step * steps / dt, loss
 
 
 def _child_main() -> None:
@@ -116,11 +155,11 @@ def _child_main() -> None:
     if on_tpu:
         config = T5Config.flan_t5_base()
         batch, enc_len, dec_len = 32, 512, 128
-        steps, warmup = 10, 2
+        steps = 10
     else:  # CPU smoke mode — same path, tiny dials (SURVEY.md §4.2)
         config = T5Config.tiny()
         batch, enc_len, dec_len = 8, 64, 16
-        steps, warmup = 4, 1
+        steps = 4
     config.dropout_rate = 0.0
     config.dtype = "bfloat16" if on_tpu else "float32"
 
@@ -135,14 +174,14 @@ def _child_main() -> None:
     results = {}
     losses = {}
     # einsum path (XLA attention)
-    tps, loss = _measure_throughput(model, config, params, batch, enc_len, dec_len, steps, warmup)
+    tps, loss = _measure_throughput(model, config, params, batch, enc_len, dec_len, steps)
     results["einsum"], losses["einsum"] = tps, loss
     # flash path (Pallas kernel) — only meaningful where the kernel runs (TPU)
     if on_tpu:
         try:
             flash_config = T5Config.from_dict({**config.to_dict(), "use_flash_attention": True})
             flash_model = T5ForConditionalGeneration(flash_config)
-            tps_f, loss_f = _measure_throughput(flash_model, flash_config, params, batch, enc_len, dec_len, steps, warmup)
+            tps_f, loss_f = _measure_throughput(flash_model, flash_config, params, batch, enc_len, dec_len, steps)
             results["flash"], losses["flash"] = tps_f, loss_f
         except Exception as e:  # a broken kernel must not kill the bench
             print(f"flash-attention path failed: {type(e).__name__}: {e}", file=sys.stderr)

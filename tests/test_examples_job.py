@@ -1,0 +1,25 @@
+"""W5 end-to-end: the examples/ job spec through the jobs CLI
+(NLP_workloads/Anyscale_job/flan-t5-batch-inference-job-setup.yml:1-7 →
+`anyscale job submit` analog)."""
+
+import os
+
+import pytest
+
+from tpu_air.job import jobs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_flan_t5_job_submit_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_AIR_JOB_ROOT", str(tmp_path))
+    spec = jobs.JobSpec.from_yaml(os.path.join(REPO, "examples", "flan_t5_job.yml"))
+    assert spec.name == "flan-t5-batch-inference"
+    assert spec.compute_config == {"num_cpus": 8, "num_chips": 8}
+    spec.working_dir = REPO
+    job_id = jobs.submit(spec, wait_for_completion=True)
+    st = jobs.get_status(job_id)
+    log = jobs.logs(job_id)
+    assert st["status"] == "succeeded", f"job failed:\n{log[-3000:]}"
+    assert "generated_output" in log and "generated 19 outputs" in log

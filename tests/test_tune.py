@@ -251,6 +251,9 @@ def test_gbdt_asha_prune_saves_rounds(air):
         param_space={"params": {"eta": tune.grid_search([0.3, 1e-6])}},
         tune_config=tune.TuneConfig(
             metric="valid-logloss", mode="min", num_samples=1, seed=3,
+            # sequential so rung comparisons are deterministic: the sane eta
+            # posts its rung scores first, then the hopeless one must lose
+            max_concurrent_trials=1,
             scheduler=tune.ASHAScheduler(max_t=rounds, grace_period=2,
                                          reduction_factor=2),
         ),

@@ -1,14 +1,34 @@
 #!/bin/sh
 # Build the native components into this directory.
-# Idempotent; skips the compile when the .so is newer than its sources.
+#
+#   build.sh            — (default) build libtpu_air_store.so, release flags
+#   build.sh sanitizers — additionally build the store hammer under ASan and
+#                         TSan (store_hammer_asan / store_hammer_tsan), the
+#                         race-detection harness SURVEY.md §5 calls for
+#
+# Idempotent; skips a compile when the output is newer than its sources.
 # Atomic: compiles to a temp name and renames, so concurrent builders never
-# corrupt a .so another process is loading, and a rebuild never truncates a
-# library that is currently mapped (the old inode lives on).
+# corrupt a binary another process is loading, and a rebuild never truncates
+# a library that is currently mapped (the old inode lives on).
 set -e
 cd "$(dirname "$0")"
-if [ libtpu_air_store.so -nt store.cpp ] 2>/dev/null; then
-  exit 0
+
+build() {
+  # build <output> <flags-and-sources...>
+  out="$1"; shift
+  if [ "$out" -nt store.cpp ] && [ "$out" -nt store_hammer.cc ] 2>/dev/null; then
+    return 0
+  fi
+  tmp="$out.tmp.$$"
+  ${CXX:-g++} -std=c++17 -g "$@" -o "$tmp" -lpthread
+  mv -f "$tmp" "$out"
+}
+
+build libtpu_air_store.so -O2 -shared -fPIC store.cpp
+
+if [ "$1" = "sanitizers" ]; then
+  build store_hammer_asan -O1 -fsanitize=address -fno-omit-frame-pointer \
+    store.cpp store_hammer.cc
+  build store_hammer_tsan -O1 -fsanitize=thread -fno-omit-frame-pointer \
+    store.cpp store_hammer.cc
 fi
-tmp="libtpu_air_store.so.tmp.$$"
-${CXX:-g++} -std=c++17 -O2 -shared -fPIC -o "$tmp" store.cpp -lpthread
-mv -f "$tmp" libtpu_air_store.so

@@ -23,6 +23,7 @@
 
 #include <errno.h>
 #include <fcntl.h>
+#include <sched.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -36,9 +37,13 @@ constexpr uint32_t kIdBytes = 32;
 
 enum SlotState : uint32_t {
   kEmpty = 0,
-  kClaimed = 1,
-  kSealed = 2,
-  kTombstone = 3,
+  // RESERVED: slot won by a CAS but id/offset/size not yet written — probers
+  // must NOT read the identity bytes (that would race the owner's memcpy).
+  // The owner publishes CLAIMED with release order once the fields are in.
+  kReserved = 1,
+  kClaimed = 2,
+  kSealed = 3,
+  kTombstone = 4,
 };
 
 struct Slot {
@@ -177,12 +182,17 @@ int64_t arena_alloc(int h, const uint8_t* id, uint64_t size) {
   Header* hdr = a.hdr;
 
   uint64_t off = hdr->cursor.fetch_add(size, std::memory_order_relaxed);
-  if (off + size > hdr->capacity) {
-    // roll back our reservation if nobody allocated after us (best effort —
-    // on failure the space is simply abandoned; the store falls back to the
-    // file path for this object anyway)
+  // Best-effort rollback of the bump reservation on ANY failure path: if no
+  // other allocation landed after ours, the cursor CAS restores `off`;
+  // otherwise the space is abandoned (the store falls back to the file path
+  // for this object anyway).  Without this, repeated re-puts of a duplicate
+  // id would permanently consume arena space.
+  auto rollback = [&]() {
     uint64_t expect = off + size;
     hdr->cursor.compare_exchange_strong(expect, off, std::memory_order_relaxed);
+  };
+  if (off + size > hdr->capacity) {
+    rollback();
     return -1;
   }
 
@@ -193,18 +203,33 @@ int64_t arena_alloc(int h, const uint8_t* id, uint64_t size) {
     uint32_t st = s.state.load(std::memory_order_acquire);
     if (st == kEmpty) {
       uint32_t expected = kEmpty;
-      if (s.state.compare_exchange_strong(expected, kClaimed,
+      if (s.state.compare_exchange_strong(expected, kReserved,
                                           std::memory_order_acq_rel)) {
         std::memcpy(s.id, id, kIdBytes);
         s.offset = off;
         s.size = size;
+        // release-publish the identity; only now may probers read s.id
+        s.state.store(kClaimed, std::memory_order_release);
         return (int64_t)(hdr->data_start + off);
       }
       st = s.state.load(std::memory_order_acquire);  // lost race; re-read
     }
-    if ((st == kClaimed || st == kSealed) && id_eq(s.id, id)) return -3;
+    while (st == kReserved) {
+      // identity unknown and being written; it resolves within a memcpy.
+      // We must wait (not skip): if it turns out to be our id, skipping
+      // would insert a duplicate further down the chain.  Spin-yield: the
+      // owner may be another process, so no futex/condvar — and the window
+      // is ~48 bytes of stores.
+      ::sched_yield();
+      st = s.state.load(std::memory_order_acquire);
+    }
+    if ((st == kClaimed || st == kSealed) && id_eq(s.id, id)) {
+      rollback();
+      return -3;
+    }
     // tombstone or other id → keep probing
   }
+  rollback();
   return -2;
 }
 

@@ -71,6 +71,15 @@ class ActorPool:
         actor = self._future_to_actor.pop(future)
         self._idle.append(actor)
 
+    def push(self, actor: ActorHandle):
+        """Add an idle actor to the pool (autoscaling hook — the data plane
+        grows a map_batches pool under backlog, Scaling_batch_inference.
+        ipynb:cc-4 'autoscaling the actor pool')."""
+        self._idle.append(actor)
+
+    def size(self) -> int:
+        return len(self._idle) + len(self._future_to_actor)
+
     # -- high-level map -----------------------------------------------------
     def map(self, fn, values: Iterable[Any]) -> Iterator[Any]:
         values = list(values)

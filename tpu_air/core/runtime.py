@@ -302,6 +302,16 @@ class Runtime:
         self.task_resources: Dict[str, Dict[str, float]] = {}
         self.task_worker: Dict[str, int] = {}
         self.queue: List[_TaskSpec] = []
+        # Actor creations wait in their own FIFO queue for resources (chip
+        # leases especially) instead of spin-waiting in the caller — an
+        # oversubscribed Tune sweep queues its trials rather than timing out
+        # (SURVEY.md §7 hard-part 1; Model_finetuning…ipynb:cc-53-54).
+        self.actor_queue: List[dict] = []
+        self.pending_actors: Dict[str, dict] = {}          # queued, not yet placed
+        self.pending_actor_tasks: Dict[str, List[_TaskSpec]] = {}
+        # Event-driven wait(): notified whenever a result object may have
+        # been sealed (task done / worker death / driver put).
+        self._obj_cv = threading.Condition()
         self._next_worker_id = itertools.count()
         self._stop = threading.Event()
         self._wakeup_r, self._wakeup_w = mp.Pipe(duplex=False)
@@ -386,17 +396,15 @@ class Runtime:
                 st = self.actors.get(worker.actor_id) if worker.actor_id else None
                 if st:
                     st.pending = max(0, st.pending - 1)
+            self._notify_objects()
             self._schedule()
         elif kind == "submit":
             spec = _TaskSpec(**msg[1])
             spec.from_worker = True
             self._enqueue(spec)
         elif kind == "create_actor":
-            kw = msg[1]
-            # May block waiting for resources — never block the listener.
-            threading.Thread(
-                target=self._create_actor, kwargs={**kw, "from_worker": True}, daemon=True
-            ).start()
+            # Non-blocking: the creation queues for resources in _schedule.
+            self._create_actor(**msg[1], from_worker=True)
         elif kind == "actor_call":
             spec = _TaskSpec(**msg[1])
             spec.from_worker = True
@@ -430,6 +438,7 @@ class Runtime:
                 self.avail["chip"] += len(st.chip_ids)
                 st.chip_ids = []
             self.workers.pop(worker.worker_id, None)
+        self._notify_objects()
         self._schedule()
 
     # -- resources ----------------------------------------------------------
@@ -475,6 +484,7 @@ class Runtime:
 
     def _schedule(self):
         spawn_needed = 0
+        self._place_queued_actors()
         with self.lock:
             remaining: List[_TaskSpec] = []
             idle = [
@@ -543,42 +553,95 @@ class Runtime:
         name: Optional[str],
         from_worker: bool = False,
     ):
-        self._check_satisfiable(resources)
-        # Actors hold their resources for their whole lifetime; block until
-        # available (chip leases especially — SURVEY.md §7 hard-part 1).
-        deadline = time.monotonic() + 120.0
+        try:
+            self._check_satisfiable(resources)
+        except TpuAirError:
+            if not from_worker:
+                raise
+            # worker-originated creation: surface the error through the ready ref
+            self.store.put(
+                _ErrorSentinel(f"resource request {resources} unsatisfiable", ""),
+                ready_id,
+            )
+            self._notify_objects()
+            return
+        # Actors hold their resources for their whole lifetime; creation
+        # QUEUES for them (FIFO) like a task rather than spin-waiting in the
+        # caller — an oversubscribed sweep waits its turn instead of timing
+        # out (SURVEY.md §7 hard-part 1).
+        rec = {
+            "actor_id": actor_id,
+            "ready_id": ready_id,
+            "payload": payload,
+            "payload_ref": payload_ref,
+            "resources": resources,
+            "name": name,
+        }
+        with self.lock:
+            self.actor_queue.append(rec)
+            self.pending_actors[actor_id] = rec
+        self._schedule()
+
+    def _place_queued_actors(self):
+        """Dispatch queued actor creations whose resources now fit.
+
+        Strict FIFO: if the head of the queue doesn't fit, later (smaller)
+        requests do NOT jump it — large chip leases must not be starved by a
+        stream of small actors."""
         while True:
             with self.lock:
-                if self._can_fit(resources):
-                    self._acquire(resources)
-                    nchips = int(resources.get("chip", 0))
-                    chip_ids = [self.free_chips.pop(0) for _ in range(nchips)]
-                    break
-            if time.monotonic() > deadline:
-                raise TpuAirError(f"timed out waiting for actor resources {resources}")
-            time.sleep(0.01)
-        worker = self._spawn_worker(actor_id=actor_id)
-        st = _ActorState(actor_id, worker, name, chip_ids, resources)
-        with self.lock:
-            self.actors[actor_id] = st
-            if name:
-                self.named_actors[name] = actor_id
-            worker.busy_task = ready_id
-            st.pending += 1
-            self.task_resources[ready_id] = {}
-            self.task_worker[ready_id] = worker.worker_id
-            worker.conn.send(
-                (
-                    "actor_create",
-                    {
-                        "task_id": ready_id,
-                        "payload": payload,
-                        "payload_ref": payload_ref,
-                        "actor_id": actor_id,
-                        "chip_ids": chip_ids,
-                    },
+                if not self.actor_queue:
+                    return
+                rec = self.actor_queue[0]
+                if not self._can_fit(rec["resources"]):
+                    return
+                self.actor_queue.pop(0)
+                self._acquire(rec["resources"])
+                nchips = int(rec["resources"].get("chip", 0))
+                chip_ids = [self.free_chips.pop(0) for _ in range(nchips)]
+            worker = self._spawn_worker(actor_id=rec["actor_id"])
+            with self.lock:
+                if rec.get("cancelled"):
+                    # kill_actor() cancelled this creation while we were
+                    # spawning (lock released around the process spawn) — the
+                    # error sentinel is already in the store; undo the
+                    # placement so nothing leaks
+                    self._release(rec["resources"])
+                    self.free_chips.extend(chip_ids)
+                    worker.alive = False
+                    self.workers.pop(worker.worker_id, None)
+                    try:
+                        worker.conn.send(("shutdown",))
+                    except OSError:
+                        pass
+                    continue
+                actor_id, ready_id = rec["actor_id"], rec["ready_id"]
+                st = _ActorState(actor_id, worker, rec["name"], chip_ids, rec["resources"])
+                self.actors[actor_id] = st
+                if rec["name"]:
+                    self.named_actors[rec["name"]] = actor_id
+                worker.busy_task = ready_id
+                st.pending += 1
+                self.task_resources[ready_id] = {}
+                self.task_worker[ready_id] = worker.worker_id
+                worker.conn.send(
+                    (
+                        "actor_create",
+                        {
+                            "task_id": ready_id,
+                            "payload": rec["payload"],
+                            "payload_ref": rec["payload_ref"],
+                            "actor_id": actor_id,
+                            "chip_ids": chip_ids,
+                        },
+                    )
                 )
-            )
+                self.pending_actors.pop(actor_id, None)
+                # flush method calls buffered while the actor was queued —
+                # the worker pipe is FIFO, so they run right after __init__
+                buffered = self.pending_actor_tasks.pop(actor_id, [])
+            for spec in buffered:
+                self._submit_actor_task_spec(spec)
 
     def submit_actor_task(self, actor_id, method, args, kwargs) -> ObjectRef:
         task_id = new_object_id()
@@ -592,12 +655,17 @@ class Runtime:
 
     def _submit_actor_task_spec(self, spec: _TaskSpec):
         with self.lock:
+            if spec.actor_id in self.pending_actors:
+                # actor is still queued for resources — buffer the call
+                self.pending_actor_tasks.setdefault(spec.actor_id, []).append(spec)
+                return
             st = self.actors.get(spec.actor_id)
             if st is None or st.dead or not st.worker.alive:
                 self.store.put(
                     _ErrorSentinel(f"ActorDiedError(actor={spec.actor_id})", ""),
                     spec.task_id,
                 )
+                self._notify_objects()
                 return
             st.pending += 1
             self.task_resources[spec.task_id] = {}
@@ -617,6 +685,21 @@ class Runtime:
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         with self.lock:
+            rec = self.pending_actors.pop(actor_id, None)
+            if rec is not None:
+                # Still queued (or mid-placement) — cancel.  The cancelled
+                # flag covers the race where _place_queued_actors already
+                # popped the record and is spawning the worker: it checks the
+                # flag under the lock before registering and rolls back.
+                rec["cancelled"] = True
+                self.actor_queue = [r for r in self.actor_queue if r["actor_id"] != actor_id]
+                buffered = self.pending_actor_tasks.pop(actor_id, [])
+                for tid in [rec["ready_id"]] + [s.task_id for s in buffered]:
+                    self.store.put(
+                        _ErrorSentinel(f"ActorDiedError(actor={actor_id})", ""), tid
+                    )
+                self._notify_objects()
+                return
             st = self.actors.get(actor_id)
             if st is None:
                 return
@@ -636,10 +719,17 @@ class Runtime:
         worker.proc.join(timeout=2)
         if worker.proc.is_alive():
             worker.proc.terminate()
+        self._schedule()  # freed chips/cpus may place queued actors
 
     # -- object plane ---------------------------------------------------------
+    def _notify_objects(self):
+        with self._obj_cv:
+            self._obj_cv.notify_all()
+
     def put(self, value) -> ObjectRef:
-        return self.store.put(value)
+        ref = self.store.put(value)
+        self._notify_objects()
+        return ref
 
     def get(self, ref, timeout: Optional[float] = None):
         if isinstance(ref, list):
@@ -656,8 +746,7 @@ class Runtime:
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: List[ObjectRef] = []
-        delay = 0.0005
-        while len(ready) < num_returns:
+        while True:
             still = []
             for r in pending:
                 if self.store.contains(r.id):
@@ -669,8 +758,16 @@ class Runtime:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(delay)
-            delay = min(delay * 2, 0.005)
+            # Event-driven: task completions / worker deaths / driver puts
+            # notify _obj_cv, so the hot ray.wait load-balance loop (W7,
+            # Scaling_batch_inference.ipynb:cc-115) wakes with no poll
+            # latency.  The 50ms cap covers objects sealed out-of-band
+            # (e.g. a worker's own store.put with no control message).
+            slot = 0.05
+            if deadline is not None:
+                slot = min(slot, max(deadline - time.monotonic(), 0.0))
+            with self._obj_cv:
+                self._obj_cv.wait(timeout=slot)
         return ready, pending
 
     # -- lifecycle -------------------------------------------------------------

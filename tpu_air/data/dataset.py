@@ -38,6 +38,7 @@ class ActorPoolStrategy:
         self.min_size = size or min_size
         self.max_size = size or max_size or max(2, self.min_size)
         self.num_chips = num_chips
+        self.scaled_to: Optional[int] = None  # set by map_batches after a run
 
 
 def _apply_fn_to_block(fn, blk, batch_size, batch_format, fn_args, fn_kwargs):
@@ -56,6 +57,118 @@ def _apply_fn_to_block(fn, blk, batch_size, batch_format, fn_args, fn_kwargs):
 @remote
 def _map_block(fn, blk, batch_size, batch_format, fn_args, fn_kwargs):
     return _apply_fn_to_block(fn, blk, batch_size, batch_format, fn_args, fn_kwargs)
+
+
+# -- block-wise shape-op tasks (no driver materialization) -------------------
+# The reference's data plane does "batching, pipelining … and memory
+# management" off-driver (Scaling_batch_inference.ipynb:cc-4); these tasks
+# keep every all-rows operation in workers reading blocks zero-copy from the
+# shared-memory store, so the driver never holds the dataset.
+
+
+@remote
+def _num_rows_task(blk) -> int:
+    return B.block_num_rows(blk)
+
+
+@remote
+def _gather_slices(spans, *blks):
+    """Concat [blks[i][start:stop] for (i, start, stop) in spans] → one block."""
+    parts = [B.block_slice(blks[i], start, stop) for i, start, stop in spans]
+    return B.concat_blocks(parts) if parts else B.block_from_rows([])
+
+
+@remote
+def _shuffle_map(blk, nb: int, seed) -> list:
+    """Scatter rows of one block uniformly into nb buckets (phase 1 of the
+    distributed two-phase shuffle)."""
+    n = B.block_num_rows(blk)
+    rng = np.random.default_rng(seed)
+    assignment = rng.integers(0, nb, size=n)
+    df = B.block_to_pandas(blk)
+    return [B.block_from_pandas(df.iloc[assignment == j]) for j in range(nb)]
+
+
+@remote
+def _shuffle_reduce(j: int, seed, *bucket_lists):
+    """Concat bucket j from every map output and locally permute (phase 2)."""
+    parts = [bl[j] for bl in bucket_lists]
+    blk = B.concat_blocks(parts)
+    df = B.block_to_pandas(blk)
+    rng = np.random.default_rng(None if seed is None else seed + 40_013 * (j + 1))
+    return B.block_from_pandas(
+        df.iloc[rng.permutation(len(df))].reset_index(drop=True)
+    )
+
+
+@remote
+def _sample_keys(blk, key: str, k: int):
+    df = B.block_to_pandas(blk)
+    vals = df[key].to_numpy()
+    if len(vals) <= k:
+        return vals
+    idx = np.random.default_rng(0).choice(len(vals), size=k, replace=False)
+    return vals[idx]
+
+
+@remote
+def _range_partition(blk, key: str, cuts) -> list:
+    """Split one block into len(cuts)+1 key ranges (phase 1 of sample sort).
+    Works for any orderable dtype — strings fall back to bisect."""
+    import bisect
+
+    df = B.block_to_pandas(blk)
+    vals = df[key].to_numpy()
+    try:
+        bucket = np.searchsorted(np.asarray(cuts), vals, side="right")
+    except (TypeError, ValueError):
+        bucket = np.fromiter(
+            (bisect.bisect_right(cuts, v) for v in vals), dtype=np.int64, count=len(vals)
+        )
+    return [B.block_from_pandas(df.iloc[bucket == j]) for j in range(len(cuts) + 1)]
+
+
+@remote
+def _range_merge(j: int, key: str, descending: bool, *part_lists):
+    parts = [pl[j] for pl in part_lists]
+    df = B.block_to_pandas(B.concat_blocks(parts))
+    df = df.sort_values(key, ascending=not descending, kind="mergesort")
+    return B.block_from_pandas(df.reset_index(drop=True))
+
+
+@remote
+def _zip_blocks(left, right):
+    l, r = B.block_to_pandas(left), B.block_to_pandas(right).reset_index(drop=True)
+    r = r.rename(columns={c: f"{c}_1" for c in r.columns if c in l.columns})
+    return B.block_from_pandas(pd.concat([l.reset_index(drop=True), r], axis=1))
+
+
+_GROUP_AGGS = ("count", "sum", "min", "max", "sumsq")
+
+
+@remote
+def _group_partial(blk, key: str):
+    """Per-block partial aggregates; partials are tiny (one row per group) so
+    the driver-side merge never sees the data itself.  sum/sumsq cover
+    numeric columns; min/max cover any orderable dtype (string min/max is
+    valid pandas groupby behavior)."""
+    df = B.block_to_pandas(blk)
+    g = df.groupby(key, dropna=False)
+    out = pd.DataFrame({"__count": g.size()})
+    for c in df.columns:
+        if c == key:
+            continue
+        if pd.api.types.is_numeric_dtype(df[c]):
+            out[f"__sum_{c}"] = g[c].sum()
+            out[f"__sumsq_{c}"] = g[c].apply(
+                lambda s: float((s.astype(float) ** 2).sum())
+            )
+        try:
+            out[f"__min_{c}"] = g[c].min()
+            out[f"__max_{c}"] = g[c].max()
+        except (TypeError, ValueError):
+            pass  # unorderable dtype (e.g. dicts) — no min/max partial
+    return out.reset_index()
 
 
 @remote
@@ -93,7 +206,7 @@ class Dataset:
 
     def count(self) -> int:
         if self._cached_num_rows is None:
-            self._cached_num_rows = sum(B.block_num_rows(b) for b in self._blocks())
+            self._row_counts()  # worker-side counting; caches the total
         return self._cached_num_rows
 
     def __len__(self) -> int:  # convenience; Ray deprecates this but HF uses len()
@@ -220,34 +333,48 @@ class Dataset:
             return Dataset(refs)
 
         strategy = compute if isinstance(compute, ActorPoolStrategy) else ActorPoolStrategy()
-        pool_size = strategy.size or min(max(strategy.min_size, 1),
-                                         max(len(self._block_refs), 1), strategy.max_size)
+        min_size = strategy.size or max(strategy.min_size, 1)
+        max_size = strategy.size or max(strategy.max_size, min_size)
+        min_size = min(min_size, max(len(self._block_refs), 1))
         chips = num_chips or strategy.num_chips
         worker_cls = _MapWorker.options(num_chips=chips or None, **ray_remote_args)
-        actors = [
-            worker_cls.remote(fn, fn_constructor_args, fn_constructor_kwargs)
-            for _ in range(pool_size)
-        ]
+
+        def make_actor():
+            return worker_cls.remote(fn, fn_constructor_args, fn_constructor_kwargs)
+
+        submit = lambda a, v: a.apply.remote(  # noqa: E731
+            v, batch_size, batch_format, fn_args, fn_kwargs
+        )
+        actors = [make_actor() for _ in range(min_size)]
         pool = ActorPool(actors)
         out_refs: List[ObjectRef] = []
         pending: List[ObjectRef] = list(self._block_refs)
         try:
-            # ordered map over blocks, recycling idle actors
             idx = 0
             while idx < len(pending) and pool.has_free():
-                pool.submit(
-                    lambda a, v: a.apply.remote(v, batch_size, batch_format, fn_args, fn_kwargs),
-                    pending[idx],
-                )
+                pool.submit(submit, pending[idx])
                 idx += 1
             for _ in range(len(pending)):
+                # Autoscale under backlog: all actors busy and blocks still
+                # queued → grow toward max_size before blocking on a result
+                # (Scaling_batch_inference.ipynb:cc-4 "autoscaling the actor
+                # pool").  Chip-leased actors queue for leases like any
+                # other actor, so scale-up never deadlocks the sweep.
+                while (
+                    idx < len(pending)
+                    and not pool.has_free()
+                    and pool.size() < max_size
+                ):
+                    a = make_actor()
+                    actors.append(a)
+                    pool.push(a)
+                    pool.submit(submit, pending[idx])
+                    idx += 1
                 out_refs.append(put(pool.get_next()))
                 if idx < len(pending):
-                    pool.submit(
-                        lambda a, v: a.apply.remote(v, batch_size, batch_format, fn_args, fn_kwargs),
-                        pending[idx],
-                    )
+                    pool.submit(submit, pending[idx])
                     idx += 1
+            strategy.scaled_to = pool.size()  # observable for tests/stats
         finally:
             from tpu_air.core import kill
 
@@ -286,47 +413,71 @@ class Dataset:
 
         return self.map_batches(batch_fn, batch_size=None, batch_format="pandas")
 
-    # -- shape ops ----------------------------------------------------------
+    # -- shape ops (block-wise via tasks; the driver only ever sees row
+    # counts and tiny metadata, never the rows themselves) -------------------
+    def _row_counts(self) -> List[int]:
+        refs = [_num_rows_task.remote(r) for r in self._block_refs]
+        counts = get(refs)
+        self._cached_num_rows = int(sum(counts))
+        return counts
+
+    def _row_range_refs(
+        self, start: int, stop: int, counts: List[int]
+    ) -> List[ObjectRef]:
+        """Refs covering global rows [start, stop).  Whole blocks pass
+        through by reference (zero copy); partial blocks become slice tasks."""
+        refs: List[ObjectRef] = []
+        off = 0
+        for ref, n in zip(self._block_refs, counts):
+            lo, hi = max(start - off, 0), min(stop - off, n)
+            if lo < hi:
+                if lo == 0 and hi == n:
+                    refs.append(ref)
+                else:
+                    refs.append(_gather_slices.remote([(0, lo, hi)], ref))
+            off += n
+            if off >= stop:
+                break
+        return refs
+
     def limit(self, n: int) -> "Dataset":
         """First n rows (SMALL_DATA dial, Model_finetuning…ipynb:cc-21)."""
-        refs: List[ObjectRef] = []
-        remaining = n
-        for ref in self._block_refs:
-            if remaining <= 0:
-                break
-            blk = get(ref)
-            rows = B.block_num_rows(blk)
-            if rows <= remaining:
-                refs.append(ref)
-                remaining -= rows
-            else:
-                refs.append(put(B.block_slice(blk, 0, remaining)))
-                remaining = 0
-        return Dataset(refs)
+        return Dataset(self._row_range_refs(0, n, self._row_counts()))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         """Rebalance into exactly ``num_blocks`` blocks
-        (Introduction…ipynb:cc-11)."""
-        df = self.to_pandas()
-        n = len(df)
-        if n == 0:
-            return Dataset([put(B.block_from_pandas(df)) for _ in range(1)])
-        sizes = [(n + i) // num_blocks for i in range(num_blocks)]
+        (Introduction…ipynb:cc-11).  Each output block is assembled by one
+        task from the input slices that overlap its row range."""
+        counts = self._row_counts()
+        total = sum(counts)
+        offsets = np.cumsum([0] + counts)
+        sizes = [(total + i) // num_blocks for i in range(num_blocks)]
         refs, start = [], 0
         for s in sizes:
-            refs.append(put(B.block_from_pandas(df.iloc[start : start + s])))
-            start += s
+            stop = start + s
+            spans, blks = [], []
+            for bi, n in enumerate(counts):
+                lo = max(start - offsets[bi], 0)
+                hi = min(stop - offsets[bi], n)
+                if lo < hi:
+                    spans.append((len(blks), int(lo), int(hi)))
+                    blks.append(self._block_refs[bi])
+            refs.append(_gather_slices.remote(spans, *blks))
+            start = stop
         return Dataset(refs)
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        from .io import df_chunks
-
-        df = self.to_pandas()
-        rng = np.random.default_rng(seed)
-        perm = rng.permutation(len(df))
-        df = df.iloc[perm].reset_index(drop=True)
+        """Distributed two-phase shuffle: per-block uniform scatter into
+        num_blocks buckets, then per-bucket concat + local permutation —
+        rows never pass through the driver."""
         nb = max(1, self.num_blocks())
-        return Dataset([put(B.block_from_pandas(part)) for part in df_chunks(df, nb)])
+        map_refs = [
+            _shuffle_map.remote(ref, nb, None if seed is None else seed + i)
+            for i, ref in enumerate(self._block_refs)
+        ]
+        return Dataset(
+            [_shuffle_reduce.remote(j, seed, *map_refs) for j in range(nb)]
+        )
 
     def train_test_split(
         self, test_size: Union[float, int], *, shuffle: bool = False,
@@ -335,27 +486,27 @@ class Dataset:
         """80/20-style split (Introduction…ipynb:cc-10; the HF-side
         ``train_test_split(seed=57)`` at Model_finetuning…ipynb:cc-13)."""
         ds = self.random_shuffle(seed=seed) if shuffle else self
-        n = ds.count()
+        counts = ds._row_counts()
+        n = sum(counts)
         ntest = int(n * test_size) if isinstance(test_size, float) else int(test_size)
         ntrain = n - ntest
-        df = ds.to_pandas()
-        train = Dataset([put(B.block_from_pandas(df.iloc[:ntrain]))])
-        test = Dataset([put(B.block_from_pandas(df.iloc[ntrain:]))])
+        train = Dataset(ds._row_range_refs(0, ntrain, counts))
+        test = Dataset(ds._row_range_refs(ntrain, n, counts))
         return train, test
 
     def split(self, n: int, *, equal: bool = True, locality_hints=None) -> List["Dataset"]:
         """Split into n shards — one per DP worker (SURVEY.md §1-L3:
         "partitioned Dataset shards" per worker)."""
-        from .io import df_chunks
-
-        df = self.to_pandas()
-        total = len(df)
+        counts = self._row_counts()
+        total = sum(counts)
         if equal:
             per = total // n
-            parts = [df.iloc[i * per : (i + 1) * per] for i in range(n)]
+            bounds = [(i * per, (i + 1) * per) for i in range(n)]
         else:
-            parts = df_chunks(df, n)
-        return [Dataset([put(B.block_from_pandas(p))]) for p in parts]
+            sizes = [(total + i) // n for i in range(n)]
+            offs = np.cumsum([0] + sizes)
+            bounds = [(int(offs[i]), int(offs[i + 1])) for i in range(n)]
+        return [Dataset(self._row_range_refs(lo, hi, counts)) for lo, hi in bounds]
 
     def union(self, *others: "Dataset") -> "Dataset":
         refs = list(self._block_refs)
@@ -364,15 +515,49 @@ class Dataset:
         return Dataset(refs)
 
     def zip(self, other: "Dataset") -> "Dataset":
-        left, right = self.to_pandas(), other.to_pandas()
-        right = right.rename(
-            columns={c: f"{c}_1" for c in right.columns if c in left.columns}
-        )
-        return Dataset([put(B.block_from_pandas(pd.concat([left, right], axis=1)))])
+        """Column-wise zip: the right side is realigned to the left's block
+        boundaries, then blocks are zipped pairwise by tasks."""
+        counts = self._row_counts()
+        offsets = np.cumsum([0] + counts)
+        rcounts = other._row_counts()
+        refs = []
+        for bi, n in enumerate(counts):
+            lo, hi = int(offsets[bi]), int(offsets[bi] + n)
+            right_refs = other._row_range_refs(lo, hi, rcounts)
+            if len(right_refs) == 1:
+                right = right_refs[0]
+            else:
+                rns = get([_num_rows_task.remote(r) for r in right_refs])
+                right = _gather_slices.remote(
+                    [(i, 0, int(rn)) for i, rn in enumerate(rns)], *right_refs
+                )
+            refs.append(_zip_blocks.remote(self._block_refs[bi], right))
+        return Dataset(refs)
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        df = self.to_pandas().sort_values(key, ascending=not descending)
-        return Dataset([put(B.block_from_pandas(df.reset_index(drop=True)))])
+        """Distributed sample sort: sample cut points, range-partition each
+        block, merge+sort each range in its own task."""
+        nb = max(1, self.num_blocks())
+        if nb == 1:
+            return Dataset(
+                [_range_merge.remote(0, key, descending, _range_partition.remote(self._block_refs[0], key, []))]
+            )
+        samples = sorted(
+            v
+            for s in get([_sample_keys.remote(r, key, 64) for r in self._block_refs])
+            for v in np.asarray(s).tolist()
+        )
+        # positional quantiles: dtype-agnostic (numeric or string keys)
+        picks = [samples[(len(samples) * (i + 1)) // nb] for i in range(nb - 1)]
+        cuts = sorted(set(picks))
+        part_refs = [_range_partition.remote(r, key, cuts) for r in self._block_refs]
+        refs = [
+            _range_merge.remote(j, key, descending, *part_refs)
+            for j in range(len(cuts) + 1)
+        ]
+        if descending:
+            refs = refs[::-1]
+        return Dataset(refs)
 
     def groupby(self, key: str) -> "GroupedData":
         """(Introduction…ipynb:cc-18: ``groupby("…").mean("…")``)."""
@@ -408,38 +593,77 @@ class Dataset:
 
 
 class GroupedData:
+    """Distributed groupby: each block computes one-row-per-group partial
+    aggregates (count/sum/min/max/sumsq) in a task; the driver only merges
+    those tiny partials and finalizes the requested statistic."""
+
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
-    def _agg(self, how: str, on: Optional[str]) -> Dataset:
-        df = self._ds.to_pandas()
-        g = df.groupby(self._key)
-        target = g[on] if on else g
-        out = getattr(target, how)()
-        if isinstance(out, pd.Series):
-            out = out.to_frame(name=f"{how}({on})" if on else how)
-        else:
-            out = out.rename(columns={c: f"{how}({c})" for c in out.columns})
-        out = out.reset_index()
+    def _merged_partials(self) -> pd.DataFrame:
+        parts = get([_group_partial.remote(r, self._key) for r in self._ds._block_refs])
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return pd.DataFrame({self._key: [], "__count": []})
+        allp = pd.concat(parts, ignore_index=True)
+        g = allp.groupby(self._key, dropna=False)
+        merged = pd.DataFrame({"__count": g["__count"].sum()})
+        for c in allp.columns:
+            if c.startswith("__sum_") or c.startswith("__sumsq_"):
+                merged[c] = g[c].sum()
+            elif c.startswith("__min_"):
+                merged[c] = g[c].min()
+            elif c.startswith("__max_"):
+                merged[c] = g[c].max()
+        return merged.reset_index()
+
+    def _finalize(self, how: str, on: Optional[str]) -> Dataset:
+        m = self._merged_partials()
+        prefix = "__min_" if how in ("min", "max") else "__sum_"
+        cols = sorted(
+            {c[len(prefix):] for c in m.columns if c.startswith(prefix)}
+        )
+        targets = [on] if on else cols
+        out = pd.DataFrame({self._key: m[self._key]})
+        for c in targets:
+            if f"{prefix}{c}" not in m.columns:
+                raise ValueError(
+                    f"groupby.{how}() unsupported for column {c!r} "
+                    f"({'non-orderable' if how in ('min', 'max') else 'non-numeric'})"
+                )
+            if how == "mean":
+                out[f"mean({c})"] = m[f"__sum_{c}"] / m["__count"]
+            elif how == "sum":
+                out[f"sum({c})"] = m[f"__sum_{c}"]
+            elif how == "min":
+                out[f"min({c})"] = m[f"__min_{c}"]
+            elif how == "max":
+                out[f"max({c})"] = m[f"__max_{c}"]
+            elif how == "std":
+                n, s, ss = m["__count"], m[f"__sum_{c}"], m[f"__sumsq_{c}"]
+                var = (ss - s * s / n) / (n - 1).clip(lower=1)
+                out[f"std({c})"] = np.sqrt(var.clip(lower=0.0))
+        out = out.sort_values(self._key).reset_index(drop=True)
         return Dataset([put(B.block_from_pandas(out))])
 
     def mean(self, on: Optional[str] = None) -> Dataset:
-        return self._agg("mean", on)
+        return self._finalize("mean", on)
 
     def sum(self, on: Optional[str] = None) -> Dataset:
-        return self._agg("sum", on)
+        return self._finalize("sum", on)
 
     def min(self, on: Optional[str] = None) -> Dataset:
-        return self._agg("min", on)
+        return self._finalize("min", on)
 
     def max(self, on: Optional[str] = None) -> Dataset:
-        return self._agg("max", on)
+        return self._finalize("max", on)
 
     def std(self, on: Optional[str] = None) -> Dataset:
-        return self._agg("std", on)
+        return self._finalize("std", on)
 
     def count(self) -> Dataset:
-        df = self._ds.to_pandas()
-        out = df.groupby(self._key).size().to_frame("count()").reset_index()
+        m = self._merged_partials()
+        out = pd.DataFrame({self._key: m[self._key], "count()": m["__count"]})
+        out = out.sort_values(self._key).reset_index(drop=True)
         return Dataset([put(B.block_from_pandas(out))])

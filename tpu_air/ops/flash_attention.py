@@ -91,7 +91,9 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, mask_ref, out_ref, lse_ref,
         # against division by zero for degenerate zero-length tiles.
         safe_l = jnp.where(l == 0.0, 1.0, l)
         out_ref[0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
-        lse_ref[0] = m_ref[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0]))
+        # lse block is (1, BQ, 1) — column layout keeps the sublane dim a
+        # multiple of 8 as the TPU lowering requires
+        lse_ref[0] = m_ref[:, :1] + jnp.log(safe_l)
 
 
 def _kernel_nb(q, k, v, m, o, lse, acc, mr, lr, **kw):
@@ -143,12 +145,15 @@ def _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k, interpr
     if kv_mask is not None:
         nb = kv_mask.shape[0]
         if nb == 1:
-            mask_map = lambda b, i, j: (0, j)  # noqa: E731
+            mask_map = lambda b, i, j: (0, 0, j)  # noqa: E731
         else:
             h_per = bh // nb
-            mask_map = lambda b, i, j: (b // h_per, j)  # noqa: E731
-        in_specs.append(pl.BlockSpec((1, block_k), mask_map))
-        args.append(kv_mask)
+            mask_map = lambda b, i, j: (b // h_per, 0, j)  # noqa: E731
+        # carried as (B, 1, Lk): the singleton sublane dim must equal the
+        # array dim for the TPU lowering (a (1, block_k) block over (B, Lk)
+        # is rejected — sublane 1 neither divides 8 nor equals B)
+        in_specs.append(pl.BlockSpec((1, 1, block_k), mask_map))
+        args.append(kv_mask[:, None, :])
 
     if bias is not None and kv_mask is not None:
         kernel = _kernel
@@ -167,11 +172,14 @@ def _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k, interpr
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            # lse as a (bh, lq, 1) column: block (1, block_q, 1) satisfies the
+            # TPU (sublane, lane) tiling rules where a (1, block_q) block over
+            # (bh, lq) does not
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),    # acc
@@ -180,7 +188,7 @@ def _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k, interpr
         ],
         interpret=interpret,
     )(*args)
-    return out, lse
+    return out, lse[..., 0]
 
 
 # --------------------------------------------------------------------------

@@ -63,9 +63,20 @@ class _TrialRunner:
             store = _rt.current_worker().store if _rt.current_worker() else None
 
             def decision_cb(rec, seq, _store=store, _tid=trial_id):
-                # stream the report to the driver (Tune watches these), then
-                # check for an async stop marker (ASHA prune).
+                # Stream the report to the driver (Tune watches these), then
+                # block briefly for the scheduler's decision ack so a prune
+                # lands BEFORE the next epoch/round spends compute (a fast
+                # trial must not outrun an async stop marker).  If the driver
+                # is slow the trial proceeds and the async `{tid}-stop`
+                # marker still catches it at a later report.
                 _store.put(rec, f"{_tid}-report-{seq}")
+                try:
+                    ok = bool(_store.get(f"{_tid}-ack-{seq}", timeout=5.0))
+                    _store.delete(f"{_tid}-ack-{seq}")
+                    if not ok:
+                        return False
+                except TimeoutError:
+                    pass
                 return not _store.contains(f"{_tid}-stop")
 
         session = Session(

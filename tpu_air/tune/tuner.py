@@ -180,17 +180,21 @@ class Tuner:
             running.append(tr)
 
         def drain_reports(tr):
-            """Feed streamed reports to the scheduler; plant stop markers."""
+            """Feed streamed reports to the scheduler; ack each decision (the
+            trial blocks on the ack so prunes land before the next round) and
+            plant the async stop marker as a backstop."""
             while True:
-                key = f"{tr['id']}-report-{tr['next_report']}"
+                seq = tr["next_report"]
+                key = f"{tr['id']}-report-{seq}"
                 if not store.contains(key):
                     return
                 rec = store.get(key)
                 store.delete(key)
                 tr["next_report"] += 1
-                if scheduler.on_result(tr["id"], rec) != CONTINUE:
-                    if not store.contains(f"{tr['id']}-stop"):
-                        store.put(True, f"{tr['id']}-stop")
+                go = scheduler.on_result(tr["id"], rec) == CONTINUE
+                if not go and not store.contains(f"{tr['id']}-stop"):
+                    store.put(True, f"{tr['id']}-stop")
+                store.put(go, f"{tr['id']}-ack-{seq}")
 
         def finalize(tr, out, err):
             idx = trials.index(tr)
@@ -201,10 +205,13 @@ class Tuner:
             )
             tpu_air.kill(tr["runner"])
             store.delete(f"{tr['id']}-stop")
-            # drop any reports that streamed after the last drain
+            # drop any reports that streamed after the last drain, and any
+            # acks the (now dead) trial never consumed
             while store.contains(f"{tr['id']}-report-{tr['next_report']}"):
                 store.delete(f"{tr['id']}-report-{tr['next_report']}")
                 tr["next_report"] += 1
+            for i in range(1, tr["next_report"]):
+                store.delete(f"{tr['id']}-ack-{i}")
 
         def complete(tr):
             """Trial future resolved: finalize, or retry per FailureConfig
@@ -230,6 +237,8 @@ class Tuner:
                 while store.contains(f"{tr['id']}-report-{tr['next_report']}"):
                     store.delete(f"{tr['id']}-report-{tr['next_report']}")
                     tr["next_report"] += 1
+                for i in range(1, tr["next_report"]):
+                    store.delete(f"{tr['id']}-ack-{i}")
                 tr["next_report"] = 1
                 latest = out.get("latest_checkpoint")
                 if latest:
@@ -244,7 +253,9 @@ class Tuner:
 
         while running:
             futures = [tr["future"] for tr in running]
-            ready, _ = tpu_air.wait(futures, num_returns=1, timeout=0.25)
+            # short slot: trials block on per-report acks, so drain latency
+            # is training latency
+            ready, _ = tpu_air.wait(futures, num_returns=1, timeout=0.05)
             for tr in list(running):
                 drain_reports(tr)
                 if tr["future"] in ready:
