@@ -123,7 +123,9 @@ def _measure_throughput(model, config, params0, batch, enc_len, dec_len, steps):
         updates, o = tx.update(grads, o, p)
         return (optax.apply_updates(p, updates), o), loss
 
-    @jax.jit
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0, 1))
     def run_steps(p, o):
         (p, o), losses = jax.lax.scan(train_step, (p, o), None, length=steps)
         return p, o, losses[-1]

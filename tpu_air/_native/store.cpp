@@ -214,15 +214,18 @@ int64_t arena_alloc(int h, const uint8_t* id, uint64_t size) {
       }
       st = s.state.load(std::memory_order_acquire);  // lost race; re-read
     }
-    while (st == kReserved) {
-      // identity unknown and being written; it resolves within a memcpy.
-      // We must wait (not skip): if it turns out to be our id, skipping
-      // would insert a duplicate further down the chain.  Spin-yield: the
-      // owner may be another process, so no futex/condvar — and the window
-      // is ~48 bytes of stores.
+    // Identity unknown while RESERVED (owner mid-memcpy); wait, because if
+    // the slot turns out to hold our id, skipping would insert a duplicate
+    // further down the chain.  The spin is BOUNDED: a process killed between
+    // reserve and publish leaves the slot RESERVED forever, and an unbounded
+    // wait would hang every alloc whose probe chain crosses it.  After the
+    // bound, treat it like a tombstone (worst case: a duplicate of an object
+    // that was never published — harmless, it can never seal).
+    for (int spin = 0; st == kReserved && spin < 100000; ++spin) {
       ::sched_yield();
       st = s.state.load(std::memory_order_acquire);
     }
+    if (st == kReserved) continue;
     if ((st == kClaimed || st == kSealed) && id_eq(s.id, id)) {
       rollback();
       return -3;

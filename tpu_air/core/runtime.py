@@ -636,12 +636,27 @@ class Runtime:
                         },
                     )
                 )
+                # Flush method calls buffered while the actor was queued
+                # BEFORE leaving pending state, all under the lock: a
+                # concurrent direct submit must not reach the worker pipe
+                # ahead of earlier buffered calls (per-caller FIFO).
+                for spec in self.pending_actor_tasks.pop(actor_id, []):
+                    st.pending += 1
+                    self.task_resources[spec.task_id] = {}
+                    self.task_worker[spec.task_id] = worker.worker_id
+                    worker.conn.send(
+                        (
+                            "actor_task",
+                            {
+                                "task_id": spec.task_id,
+                                "payload": spec.payload,
+                                "payload_ref": spec.payload_ref,
+                                "actor_id": spec.actor_id,
+                                "method": spec.method,
+                            },
+                        )
+                    )
                 self.pending_actors.pop(actor_id, None)
-                # flush method calls buffered while the actor was queued —
-                # the worker pipe is FIFO, so they run right after __init__
-                buffered = self.pending_actor_tasks.pop(actor_id, [])
-            for spec in buffered:
-                self._submit_actor_task_spec(spec)
 
     def submit_actor_task(self, actor_id, method, args, kwargs) -> ObjectRef:
         task_id = new_object_id()
@@ -822,6 +837,16 @@ def init(
         if include_dashboard:  # honor an explicit request on reinit too
             _start_dashboard(dashboard_port)
         return _runtime
+    # multi-host rendezvous first (no-op unless the TPU_AIR_COORDINATOR env
+    # contract is set): after this, jax sees the global device list and this
+    # process knows its rank (SURVEY.md §3.6 "initialize the multi-host
+    # runtime on every host")
+    try:
+        from tpu_air.parallel import distributed as _dist
+
+        _dist.ensure_initialized()
+    except Exception as e:  # rendezvous failure must not mask the local path
+        print(f"tpu_air: multi-host rendezvous failed: {e}", file=sys.stderr)
     _runtime = Runtime(num_cpus=num_cpus, num_chips=num_chips, **kwargs)
     if include_dashboard is None:
         include_dashboard = os.environ.get("TPU_AIR_DASHBOARD", "0") == "1"

@@ -354,17 +354,25 @@ class Dataset:
             while idx < len(pending) and pool.has_free():
                 pool.submit(submit, pending[idx])
                 idx += 1
+            def can_scale() -> bool:
+                if pool.size() >= max_size:
+                    return False
+                if not chips:
+                    return True
+                # A chip-leased scale-up actor queues for a lease the pool's
+                # own actors may hold until THIS map_batches ends — submitting
+                # a block to it would deadlock the ordered get_next loop.
+                # Only grow when the scheduler has a free lease right now.
+                from tpu_air.core.runtime import get_runtime
+
+                return get_runtime().avail.get("chip", 0.0) >= float(chips)
+
             for _ in range(len(pending)):
                 # Autoscale under backlog: all actors busy and blocks still
                 # queued → grow toward max_size before blocking on a result
                 # (Scaling_batch_inference.ipynb:cc-4 "autoscaling the actor
-                # pool").  Chip-leased actors queue for leases like any
-                # other actor, so scale-up never deadlocks the sweep.
-                while (
-                    idx < len(pending)
-                    and not pool.has_free()
-                    and pool.size() < max_size
-                ):
+                # pool").
+                while idx < len(pending) and not pool.has_free() and can_scale():
                     a = make_actor()
                     actors.append(a)
                     pool.push(a)
@@ -547,6 +555,8 @@ class Dataset:
             for s in get([_sample_keys.remote(r, key, 64) for r in self._block_refs])
             for v in np.asarray(s).tolist()
         )
+        if not samples:  # all blocks empty — nothing to order
+            return Dataset(list(self._block_refs))
         # positional quantiles: dtype-agnostic (numeric or string keys)
         picks = [samples[(len(samples) * (i + 1)) // nb] for i in range(nb - 1)]
         cuts = sorted(set(picks))

@@ -1,0 +1,289 @@
+"""Multi-host runtime: jax.distributed rendezvous + per-host agents.
+
+The reference runs real multi-node clusters (Install_locally.md:58-64;
+flan-t5-batch-inference-job-setup.yml:2-3 hands the job a managed multi-node
+compute config).  The TPU-native shape of that is JAX's multi-controller
+SPMD: every host of a pod slice runs the SAME program; host 0 additionally
+runs the user's driver code.  This module owns:
+
+* **rendezvous** — `ensure_initialized()` joins the cluster-wide coordination
+  service (`jax.distributed.initialize`) from env or explicit args; after it,
+  `jax.devices()` is the GLOBAL device list and pjit programs span hosts, ICI
+  collectives intra-slice and DCN across slices (SURVEY.md §2D).
+* **per-host agents** — host 0 cannot call remote Python on other hosts via
+  XLA; it ships *programs*.  `HostAgentServer` (driver) + `agent_loop`
+  (non-zero hosts) form the control plane: cloudpickled thunks broadcast over
+  a socket, executed lockstep on every host — exactly how the SPMD train step
+  launches everywhere (SURVEY.md §3.6, §7 hard-part 3).
+* **local emulation** — `spawn_local_cluster()` forks N processes with
+  `xla_force_host_platform_device_count` CPU devices each, so multi-host
+  tests run on one machine with zero TPUs (SURVEY.md §4.3's "multi-node
+  without a cluster" technique).
+
+Env contract (set by the pod launcher / job YAML):
+    TPU_AIR_COORDINATOR   host:port of process 0 (jax coordination service)
+    TPU_AIR_NUM_PROCESSES world size (one per host)
+    TPU_AIR_PROCESS_ID    this host's rank
+    TPU_AIR_CONTROL       host:port of the agent control plane (driver side)
+"""
+
+from __future__ import annotations
+
+import multiprocessing.connection as mpc
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, List, Optional
+
+_AUTHKEY = b"tpu_air-multihost"
+_initialized = False
+
+
+# --------------------------------------------------------------------------
+# rendezvous
+# --------------------------------------------------------------------------
+
+
+def ensure_initialized(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the jax.distributed cluster if configured; returns True when this
+    process is part of a multi-process run.  Idempotent.  Reads the env
+    contract when args are omitted — `tpu_air.init()` calls this first so a
+    job YAML env block is all a multi-host launch needs."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("TPU_AIR_COORDINATOR")
+    num_processes = num_processes or _env_int("TPU_AIR_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _env_int("TPU_AIR_PROCESS_ID")
+    if not coordinator or not num_processes or num_processes <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id or 0,
+    )
+    _initialized = True
+    return True
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name)
+    return int(raw) if raw else None
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    return process_index() == 0
+
+
+# --------------------------------------------------------------------------
+# control plane: program broadcast from host 0
+# --------------------------------------------------------------------------
+
+
+class HostAgentServer:
+    """Driver-side (host 0) control plane.
+
+    Accepts one connection per non-zero host, then `run(fn)` broadcasts a
+    cloudpickled zero-arg thunk, executes it locally too (multi-controller
+    SPMD requires every process to enter the same computation), and gathers
+    per-host results.  Exceptions on any host propagate with their remote
+    traceback."""
+
+    def __init__(self, num_processes: int, address: Optional[tuple] = None):
+        self.num_processes = num_processes
+        addr = address or ("127.0.0.1", 0)
+        self._listener = mpc.Listener(addr, authkey=_AUTHKEY)
+        self.address = self._listener.address
+        self._conns: dict[int, Any] = {}
+
+    def wait_for_agents(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while len(self._conns) < self.num_processes - 1:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {len(self._conns)}/{self.num_processes - 1} host "
+                    "agents connected"
+                )
+            conn = self._listener.accept()  # blocks; launcher enforces timeout
+            pid = conn.recv()  # handshake: agent sends its process_id
+            self._conns[int(pid)] = conn
+
+    def run(self, fn: Callable[[], Any]) -> List[Any]:
+        """Execute ``fn`` on every host (including this one); returns results
+        ordered by process id."""
+        import cloudpickle
+
+        payload = cloudpickle.dumps(fn)
+        for conn in self._conns.values():
+            conn.send(("run", payload))
+        local = _call_guarded(fn)
+        results: dict[int, Any] = {0: local}
+        for pid, conn in self._conns.items():
+            results[pid] = conn.recv()
+        out = []
+        for pid in range(self.num_processes):
+            status, value = results[pid]
+            if status == "err":
+                raise RuntimeError(f"host {pid} failed:\n{value}")
+            out.append(value)
+        return out
+
+    def barrier(self) -> None:
+        self.run(lambda: None)
+
+    def shutdown(self) -> None:
+        for conn in self._conns.values():
+            try:
+                conn.send(("exit", None))
+                conn.close()
+            except OSError:
+                pass
+        self._listener.close()
+
+
+def _call_guarded(fn):
+    try:
+        return ("ok", fn())
+    except BaseException:  # noqa: BLE001 - control-plane boundary
+        return ("err", traceback.format_exc())
+
+
+def agent_loop(control_address, process_id: int) -> None:
+    """Non-zero hosts: connect to host 0 and execute broadcast programs in
+    lockstep until told to exit."""
+    import cloudpickle
+
+    conn = mpc.Client(tuple(control_address) if isinstance(control_address, list)
+                      else control_address, authkey=_AUTHKEY)
+    conn.send(process_id)
+    while True:
+        kind, payload = conn.recv()
+        if kind == "exit":
+            return
+        fn = cloudpickle.loads(payload)
+        conn.send(_call_guarded(fn))
+
+
+# --------------------------------------------------------------------------
+# local multi-process emulation (tests / single machine)
+# --------------------------------------------------------------------------
+
+_AGENT_MAIN = """\
+import os, sys
+from tpu_air.parallel import distributed as D
+D.ensure_initialized()
+host, port = os.environ["TPU_AIR_CONTROL"].rsplit(":", 1)
+D.agent_loop((host, int(port)), int(os.environ["TPU_AIR_PROCESS_ID"]))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class LocalCluster:
+    """N-process virtual cluster on one machine: process 0 is the caller's
+    subprocess-free *driver script*; use `spawn_local_cluster` from a fresh
+    process whose jax is not yet initialized."""
+
+    def __init__(self, server: HostAgentServer, procs: List[subprocess.Popen]):
+        self.server = server
+        self.procs = procs
+
+    def run(self, fn):
+        return self.server.run(fn)
+
+    def shutdown(self):
+        self.server.shutdown()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def spawn_local_cluster(
+    num_processes: int, devices_per_process: int = 4, timeout: float = 120.0
+) -> LocalCluster:
+    """Start a local multi-host emulation: this process becomes host 0 of a
+    ``num_processes``-process jax.distributed cluster with
+    ``devices_per_process`` virtual CPU devices each; the other hosts run
+    `agent_loop` in subprocesses.  Must be called before jax is imported
+    (the XLA device-count flag binds at backend init)."""
+    if "jax" in sys.modules and getattr(sys.modules["jax"], "_tpu_air_probe", None):
+        pass  # best-effort; callers use a fresh process anyway
+    coord_port = _free_port()
+    coordinator = f"127.0.0.1:{coord_port}"
+
+    server = HostAgentServer(num_processes)
+    host, port = server.address
+
+    env_base = dict(os.environ)
+    env_base.pop("PALLAS_AXON_POOL_IPS", None)  # never let agents touch the TPU tunnel
+    env_base.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(
+            env_base.get("XLA_FLAGS", "").replace(
+                "--xla_force_host_platform_device_count=8", ""
+            ).strip()
+            + f" --xla_force_host_platform_device_count={devices_per_process}"
+        ).strip(),
+        TPU_AIR_COORDINATOR=coordinator,
+        TPU_AIR_NUM_PROCESSES=str(num_processes),
+        TPU_AIR_CONTROL=f"{host}:{port}",
+    )
+
+    procs = []
+    for pid in range(1, num_processes):
+        env = dict(env_base)
+        env["TPU_AIR_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _AGENT_MAIN],
+                env=env,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            )
+        )
+
+    # become host 0
+    os.environ.update(
+        {k: env_base[k] for k in ("JAX_PLATFORMS", "XLA_FLAGS", "TPU_AIR_COORDINATOR",
+                                  "TPU_AIR_NUM_PROCESSES", "TPU_AIR_CONTROL")}
+    )
+    os.environ["TPU_AIR_PROCESS_ID"] = "0"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    ensure_initialized()
+
+    t = threading.Thread(target=server.wait_for_agents, kwargs={"timeout": timeout})
+    t.start()
+    t.join(timeout)
+    if t.is_alive() or len(server._conns) < num_processes - 1:
+        server._listener.close()  # unblocks the accept() so the thread exits
+        for p in procs:
+            p.kill()
+        raise TimeoutError("host agents failed to connect")
+    return LocalCluster(server, procs)
