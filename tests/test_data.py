@@ -295,7 +295,7 @@ def test_actor_pool_autoscales_under_backlog(air):
     """min_size=1 pool must grow toward max_size when blocks queue up."""
     from tpu_air.data.dataset import ActorPoolStrategy
 
-    ds = tad.from_items([{"x": i} for i in range(64)]).repartition(8)
+    ds = tad.from_items([{"x": i} for i in range(64)]).repartition(16)
     strat = ActorPoolStrategy(min_size=1, max_size=4)
 
     class Slowish:

@@ -175,8 +175,22 @@ def _resolve_args(store: ObjectStore, args, kwargs):
     return args, kwargs
 
 
-def _worker_main(worker_id: int, store_root: str, conn: mpc.Connection):
+def _worker_main(
+    worker_id: int,
+    store_root: str,
+    conn: mpc.Connection,
+    driver_env: Optional[Dict[str, str]] = None,
+):
     global _worker_ctx
+    if driver_env:
+        # apply the driver's environ as of spawn time (forkserver children
+        # otherwise see the env snapshot from forkserver start) — must happen
+        # before any jax backend init reads JAX_PLATFORMS/XLA_FLAGS
+        for k, v in driver_env.items():
+            os.environ[k] = v
+        for k in list(os.environ):
+            if k not in driver_env:
+                os.environ.pop(k, None)
     store = ObjectStore(store_root)
     _worker_ctx = _WorkerContext(conn, store, worker_id)
     actors: Dict[str, Any] = {}
@@ -295,6 +309,7 @@ class Runtime:
         self.avail = {"cpu": float(self.num_cpus), "chip": float(self.num_chips)}
         method = start_method or os.environ.get("TPU_AIR_START_METHOD", "fork")
         self.mp_ctx = mp.get_context(method)
+        self._fs_ctx = None  # lazy preloaded forkserver (see _pick_ctx)
         self.lock = threading.RLock()
         self.workers: Dict[int, _WorkerState] = {}
         self.actors: Dict[str, _ActorState] = {}
@@ -325,19 +340,38 @@ class Runtime:
     def _pick_ctx(self):
         """fork is fast, but forking after a JAX/XLA backend is live in this
         process inherits dead compiler threadpools → child deadlocks on its
-        first jax op.  Switch to spawn once a backend exists."""
+        first jax op.  Once a backend exists, switch to a preloaded
+        FORKSERVER: the server process imports the heavy module graph once
+        (worker_preload.py — jax/pandas/numpy, no backend init) and children
+        fork from it in ~10ms, vs ~3s of re-imports per spawn worker."""
         if self.mp_ctx.get_start_method() == "fork":
             xb = sys.modules.get("jax._src.xla_bridge")
             if xb is not None and getattr(xb, "_backends", None):
-                return mp.get_context("spawn")
+                if self._fs_ctx is None:
+                    # NB: the forkserver is a process-global singleton; the
+                    # preload applies to any other forkserver user in this
+                    # process, and if one is already running the preload is
+                    # silently skipped (workers then pay the imports — slower,
+                    # still correct).  Env snapshot staleness is handled by
+                    # shipping the driver's current environ with each worker
+                    # (_spawn_worker) and applying it in _worker_main before
+                    # any backend init.
+                    ctx = mp.get_context("forkserver")
+                    ctx.set_forkserver_preload(["tpu_air.core.worker_preload"])
+                    self._fs_ctx = ctx
+                return self._fs_ctx
         return self.mp_ctx
 
     def _spawn_worker(self, actor_id: Optional[str] = None) -> _WorkerState:
         wid = next(self._next_worker_id)
         parent, child = mp.Pipe(duplex=True)
+        # Ship the driver's CURRENT environ: forkserver children inherit the
+        # env frozen at server start, so vars set since (JAX_PLATFORMS,
+        # multi-host contract, …) must be re-applied in the worker before it
+        # initializes any backend.
         proc = self._pick_ctx().Process(
             target=_worker_main,
-            args=(wid, self.store_root, child),
+            args=(wid, self.store_root, child, dict(os.environ)),
             daemon=True,
             name=f"tpu_air-worker-{wid}",
         )

@@ -1,0 +1,33 @@
+"""Forkserver preload set.
+
+Worker processes are forked from a forkserver that has already imported the
+heavy module graph below (jax's import alone is ~2s; pandas ~0.7s), so each
+worker starts in ~10ms instead of paying the imports again — the reason a
+BatchPredictor actor pool can spin up in milliseconds once the driver holds
+a live jax backend (fork would inherit dead XLA threadpools; spawn would
+re-import everything).
+
+IMPORTANT: modules only — nothing here may initialize a jax backend or touch
+devices; children initialize their own backends on first use.
+"""
+
+try:  # noqa: SIM105
+    import numpy  # noqa: F401
+except Exception:
+    pass
+try:
+    import pandas  # noqa: F401
+except Exception:
+    pass
+try:
+    import jax  # noqa: F401
+except Exception:
+    pass
+try:
+    import sklearn.ensemble  # noqa: F401  (GBDT workloads, W8/W9)
+except Exception:
+    pass
+try:
+    import tpu_air.core.runtime  # noqa: F401
+except Exception:
+    pass

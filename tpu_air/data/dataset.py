@@ -357,6 +357,11 @@ class Dataset:
             def can_scale() -> bool:
                 if pool.size() >= max_size:
                     return False
+                # grow only while there's enough queued work to keep the
+                # bigger pool busy (>= 2 blocks per actor) — spinning up an
+                # actor per near-empty block costs more than it saves
+                if len(pending) - idx < 2 * (pool.size() + 1):
+                    return False
                 if not chips:
                     return True
                 # A chip-leased scale-up actor queues for a lease the pool's

@@ -129,14 +129,18 @@ class Session:
 
 
 def _default_sinks(run_dir: str) -> List:
-    sinks = []
+    """Tensorboard logging is opt-in (TPU_AIR_TENSORBOARD=1): the reference
+    pins tensorboardX but never configures it (SURVEY.md §5 "Sinks pinned but
+    not configured"), and the writer's protobuf import chain costs ~2.5s per
+    worker process — too heavy to pay silently in every trial."""
+    if os.environ.get("TPU_AIR_TENSORBOARD", "0") != "1":
+        return []
     try:
         from tpu_air.utils.metrics import TensorboardSink
 
-        sinks.append(TensorboardSink(run_dir))
+        return [TensorboardSink(run_dir)]
     except Exception:
-        pass
-    return sinks
+        return []
 
 
 # -- module-level session (what user train loops import) ---------------------

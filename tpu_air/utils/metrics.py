@@ -8,24 +8,41 @@ from typing import Any, Dict
 
 
 class TensorboardSink:
-    def __init__(self, log_dir: str):
-        from tensorboardX import SummaryWriter
+    """Lazy: the tensorboardX import chain costs ~2.5s (protobuf), so the
+    writer is created on first log, not at session construction.  Presence is
+    still probed at construction (find_spec is cheap) so callers' ImportError
+    fallbacks keep working."""
 
-        self.writer = SummaryWriter(log_dir)
+    def __init__(self, log_dir: str):
+        import importlib.util
+
+        if importlib.util.find_spec("tensorboardX") is None:
+            raise ImportError("tensorboardX is not installed")
+        self.log_dir = log_dir
+        self.writer = None
+
+    def _ensure_writer(self):
+        if self.writer is None:
+            from tensorboardX import SummaryWriter
+
+            self.writer = SummaryWriter(self.log_dir)
+        return self.writer
 
     def log(self, metrics: Dict[str, Any], step: int):
+        w = self._ensure_writer()
         for k, v in metrics.items():
             if k.startswith("_"):
                 continue
             try:
-                self.writer.add_scalar(k, float(v), step)
+                w.add_scalar(k, float(v), step)
             except (TypeError, ValueError):
                 pass
-        self.writer.flush()
+        w.flush()
 
     def close(self):
         try:
-            self.writer.close()
+            if self.writer is not None:
+                self.writer.close()
         except Exception:
             pass
 
