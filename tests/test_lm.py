@@ -1,0 +1,141 @@
+"""Long-context LM + sequence parallelism tests (first-class long-context:
+ring attention over a ``sequence`` mesh axis; cf. ops/ring_attention.py).
+
+Run on the 8-device virtual CPU mesh (tests/conftest.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpu_air.models.lm import CausalLM, LMConfig, lm_loss
+from tpu_air.parallel.sequence_parallel import (
+    init_sp_params,
+    make_sp_mesh,
+    make_sp_train_step,
+    shard_batch,
+    shift_targets,
+)
+
+B, L, V = 2, 64, 128
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=V, d_model=32, n_layers=2, n_heads=2, head_dim=16,
+                d_ff=64, max_seq_len=L)
+    base.update(kw)
+    return LMConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, V, size=(B, L)).astype(np.int32)
+    return jnp.asarray(ids)
+
+
+def test_forward_shapes(batch):
+    cfg = tiny_cfg()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    logits = model.apply({"params": params}, batch)
+    assert logits.shape == (B, L, V)
+    s, c = lm_loss(logits, batch, cfg.pad_token_id)
+    assert np.isfinite(float(s)) and float(c) > 0
+
+
+def test_causality(batch):
+    """Future tokens must not influence past logits."""
+    cfg = tiny_cfg()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    base = model.apply({"params": params}, batch)
+    mutated = batch.at[:, L // 2:].set(7)
+    out = model.apply({"params": params}, mutated)
+    np.testing.assert_allclose(
+        np.asarray(base[:, : L // 2 - 1]), np.asarray(out[:, : L // 2 - 1]),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_ring_forward_matches_dense(batch):
+    """shard_map ring attention over sequence == single-device dense."""
+    from tpu_air.parallel.sequence_parallel import _shard_map
+    from jax.sharding import PartitionSpec as P
+
+    cfg = tiny_cfg()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    dense = model.apply({"params": params}, batch)
+
+    mesh = make_sp_mesh(8, dp=2, sp=4)
+    ring_cfg = tiny_cfg(attention="ring", sequence_axis="sequence")
+    ring_model = CausalLM(ring_cfg)
+
+    def local_fwd(p, ids):
+        li = ids.shape[1]
+        off = jax.lax.axis_index("sequence") * li
+        pos = jnp.broadcast_to(off + jnp.arange(li, dtype=jnp.int32), ids.shape)
+        return ring_model.apply({"params": p}, ids, pos)
+
+    fwd = _shard_map(local_fwd, mesh=mesh,
+                     in_specs=(P(), P("data", "sequence")),
+                     out_specs=P("data", "sequence"))
+    ring = jax.jit(fwd)(params, batch)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sp_train_step_runs_and_learns(batch):
+    """One dp=2 x sp=4 train step: finite decreasing loss, replicated params."""
+    cfg = tiny_cfg()
+    mesh = make_sp_mesh(8, dp=2, sp=4)
+    tx = optax.adam(1e-2)
+    step, _ = make_sp_train_step(cfg, mesh, tx)
+    params = init_sp_params(cfg, mesh, seed=0)
+    opt_state = jax.device_put(
+        tx.init(params), jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    )
+    targets = shift_targets(batch, cfg.pad_token_id)
+    ids, tgt = shard_batch(mesh, batch, targets)
+    losses = []
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, ids, tgt)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_sp_grads_match_single_device(batch):
+    """The sequence-parallel psum'd gradient equals the single-device one."""
+    cfg = tiny_cfg()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    targets = shift_targets(batch, cfg.pad_token_id)
+
+    from tpu_air.models.lm import lm_loss_with_targets
+
+    def dense_loss(p):
+        logits = model.apply({"params": p}, batch)
+        s, c = lm_loss_with_targets(logits, targets, cfg.pad_token_id)
+        return s / jnp.maximum(c, 1.0)
+
+    gd = jax.grad(dense_loss)(params)
+
+    mesh = make_sp_mesh(8, dp=2, sp=4)
+    # recover the psum'd grads from one sp step with SGD(lr=1): delta = -grad
+    tx = optax.sgd(1.0)
+    step, _ = make_sp_train_step(cfg, mesh, tx)
+    p0 = init_sp_params(cfg, mesh, seed=0)
+    import jax.tree_util as jtu
+
+    p0_copy = jtu.tree_map(jnp.copy, p0)
+    opt_state = tx.init(p0)
+    ids, tgt = shard_batch(mesh, batch, targets)
+    p1, _, _ = step(p0, opt_state, ids, tgt)
+    gs = jtu.tree_map(lambda a, b: np.asarray(a - b), p0_copy, p1)
+    flat_d, _ = jax.flatten_util.ravel_pytree(gd)
+    flat_s, _ = jax.flatten_util.ravel_pytree(gs)
+    np.testing.assert_allclose(np.asarray(flat_d), np.asarray(flat_s),
+                               rtol=5e-4, atol=5e-4)

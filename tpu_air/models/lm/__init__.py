@@ -1,0 +1,4 @@
+from .config import LMConfig
+from .modeling import CausalLM, lm_loss, lm_loss_with_targets
+
+__all__ = ["LMConfig", "CausalLM", "lm_loss", "lm_loss_with_targets"]

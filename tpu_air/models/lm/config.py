@@ -1,0 +1,55 @@
+"""Config for the long-context causal LM family."""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+
+@dataclass
+class LMConfig:
+    """Decoder-only transformer (RoPE + SwiGLU, pre-RMSNorm) — the
+    framework's long-context flagship.  ``attention`` picks the kernel:
+
+    * ``"dense"`` — XLA einsum softmax (baseline, any backend);
+    * ``"flash"`` — the Pallas blockwise kernel (ops/flash_attention.py);
+    * ``"ring"``  — ring attention over the ``sequence_axis`` mesh axis
+      (ops/ring_attention.py): each device holds L/P of the sequence and
+      K/V shards rotate over ICI, so context length scales with the mesh.
+    """
+
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    head_dim: Optional[int] = None  # default d_model // n_heads
+    d_ff: Optional[int] = None      # default 4 * d_model (SwiGLU uses 2/3)
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    rmsnorm_eps: float = 1e-6
+    dropout_rate: float = 0.0
+    dtype: str = "float32"
+    tie_embeddings: bool = True
+    attention: str = "dense"          # dense | flash | ring
+    sequence_axis: Optional[str] = None  # mesh axis for ring attention
+    block_q: int = 128
+    block_k: int = 128
+    pad_token_id: int = 0
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.d_model // self.n_heads
+        if self.d_ff is None:
+            self.d_ff = int(8 * self.d_model / 3 + 255) // 256 * 256
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LMConfig":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 384) -> "LMConfig":
+        return cls(vocab_size=vocab_size, d_model=64, n_layers=2, n_heads=4,
+                   head_dim=16, d_ff=128, max_seq_len=512)

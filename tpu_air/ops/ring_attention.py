@@ -105,22 +105,16 @@ def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sequence",
     ``mesh`` and run ring attention via shard_map."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    try:
-        from jax import shard_map  # jax >= 0.7
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
+    from tpu_air.parallel.shardmap_compat import shard_map_unchecked
 
     spec = P(None, axis_name, None)
     body = functools.partial(
         ring_attention, axis_name=axis_name, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k,
     )
-    common = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    try:
-        # check_vma=False: pallas_call outputs don't carry vma metadata yet
-        fn = shard_map(body, check_vma=False, **common)
-    except TypeError:  # pragma: no cover - older jax spells it check_rep
-        fn = shard_map(body, check_rep=False, **common)
+    fn = shard_map_unchecked(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return fn(q, k, v)

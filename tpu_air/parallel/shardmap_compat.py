@@ -1,0 +1,18 @@
+"""shard_map version-compat shim, shared by every mapped-kernel caller
+(ring attention, sequence-parallel step): jax moved shard_map out of
+experimental (>=0.7) and renamed check_rep -> check_vma."""
+
+from __future__ import annotations
+
+
+def shard_map_unchecked(fn, **kw):
+    """shard_map with replication checking off (pallas_call outputs don't
+    carry vma metadata yet)."""
+    try:
+        from jax import shard_map as sm  # jax >= 0.7
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(fn, check_vma=False, **kw)
+    except TypeError:  # pragma: no cover - older spelling
+        return sm(fn, check_rep=False, **kw)
