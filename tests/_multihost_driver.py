@@ -59,6 +59,13 @@ def main() -> int:
     cluster = spawn_local_cluster(NPROC, LOCAL_DEVS)
     try:
         results = cluster.run(spmd_train_step)
+        nodes = {n["node_id"]: n for n in cluster.nodes()}
+        if nodes:  # gcs available: assert on whoever actually registered
+            # (agent registration is best-effort by design)
+            for nid in ("host-0", "host-1"):
+                info = nodes.get(nid)
+                assert info is None or info["alive"], f"{nid} dead: {nodes}"
+            print(f"gcs membership: {sorted(nodes)}")
     finally:
         cluster.shutdown()
     losses = [r[0] for r in results]

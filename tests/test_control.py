@@ -1,0 +1,92 @@
+"""C++ GCS control-plane tests (SURVEY.md §2B GCS row: cluster metadata,
+actor directory, node membership, heartbeat failure detection)."""
+
+import time
+
+import pytest
+
+try:
+    from tpu_air.control import GcsClient, HeartbeatThread, start_gcs
+    _gcs_err = None
+except Exception as e:  # pragma: no cover - missing protobuf toolchain
+    _gcs_err = e
+
+pytestmark = pytest.mark.skipif(
+    _gcs_err is not None, reason=f"gcs unavailable: {_gcs_err}"
+)
+
+
+@pytest.fixture()
+def gcs():
+    proc, port = start_gcs(dead_after_ms=600)
+    client = GcsClient(f"127.0.0.1:{port}")
+    yield client, f"127.0.0.1:{port}"
+    client.close()
+    proc.kill()
+
+
+def test_kv_roundtrip(gcs):
+    client, _ = gcs
+    client.kv_put("mesh/topology", b"v5e-8")
+    assert client.kv_get("mesh/topology") == b"v5e-8"
+    client.kv_del("mesh/topology")
+    assert client.kv_get("mesh/topology") is None
+
+
+def test_node_membership_and_failure_detection(gcs):
+    client, addr = gcs
+    client.register_node("host-0", address="127.0.0.1:9999", num_chips=4)
+    client.register_node("host-1", address="127.0.0.1:9998", num_chips=4)
+    hb = HeartbeatThread(addr, "host-0", interval=0.1)
+    hb.start()
+    time.sleep(0.9)  # host-1 never heartbeats past dead_after=600ms
+    nodes = {n["node_id"]: n for n in client.list_nodes()}
+    assert nodes["host-0"]["alive"] is True
+    assert nodes["host-1"]["alive"] is False, "dead host not detected"
+    assert nodes["host-0"]["num_chips"] == 4
+    hb.stop()
+
+
+def test_actor_directory(gcs):
+    client, _ = gcs
+    client.register_actor("a-123", node_id="host-0", name="trainer",
+                          chip_ids=[0, 1])
+    byname = client.lookup_actor("trainer")
+    assert byname and byname["actor_id"] == "a-123" and byname["chip_ids"] == [0, 1]
+    client.mark_actor_dead("a-123")
+    assert client.lookup_actor("trainer") is None  # name released
+    byid = client.lookup_actor("a-123")
+    assert byid and byid["dead"] is True
+
+
+def test_object_directory(gcs):
+    client, _ = gcs
+    assert client.locate_object("obj-1") is None
+    client.publish_object("obj-1", "host-0", size_bytes=128)
+    client.publish_object("obj-1", "host-1", size_bytes=128)
+    loc = client.locate_object("obj-1")
+    assert sorted(loc["node_ids"]) == ["host-0", "host-1"]
+
+
+def test_concurrent_clients(gcs):
+    import threading
+
+    client, addr = gcs
+    errs = []
+
+    def worker(i):
+        try:
+            c = GcsClient(addr)
+            for j in range(50):
+                c.kv_put(f"k{i}-{j}", bytes([i, j]))
+                assert c.kv_get(f"k{i}-{j}") == bytes([i, j])
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs

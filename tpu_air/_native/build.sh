@@ -26,6 +26,21 @@ build() {
 
 build libtpu_air_store.so -O2 -shared -fPIC store.cpp
 
+# GCS control-plane daemon (gcs.proto over framed TCP).  Built when protoc +
+# protobuf dev headers exist; regenerates the C++ and Python bindings when
+# the schema changes.
+if command -v protoc >/dev/null 2>&1 && [ -e /usr/include/google/protobuf/message.h ]; then
+  if [ ! -e gcs.pb.cc ] || [ gcs.proto -nt gcs.pb.cc ]; then
+    protoc --cpp_out=. --python_out=../control gcs.proto
+  fi
+  if [ ! -e tpu_air_gcs ] || [ gcs_server.cpp -nt tpu_air_gcs ] || [ gcs.pb.cc -nt tpu_air_gcs ]; then
+    tmp="tpu_air_gcs.tmp.$$"
+    ${CXX:-g++} -std=c++17 -O2 -o "$tmp" gcs_server.cpp gcs.pb.cc \
+      $(pkg-config --cflags --libs protobuf 2>/dev/null || echo -lprotobuf) -lpthread
+    mv -f "$tmp" tpu_air_gcs
+  fi
+fi
+
 if [ "$1" = "sanitizers" ]; then
   build store_hammer_asan -O1 -fsanitize=address -fno-omit-frame-pointer \
     store.cpp store_hammer.cc

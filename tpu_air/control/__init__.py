@@ -1,0 +1,6 @@
+"""tpu_air.control — C++ GCS control plane (SURVEY.md §2B GCS row): cluster
+membership, heartbeats/failure detection, actor + object directories, KV."""
+
+from .client import GcsClient, HeartbeatThread, ensure_gcs_binary, start_gcs
+
+__all__ = ["GcsClient", "HeartbeatThread", "ensure_gcs_binary", "start_gcs"]

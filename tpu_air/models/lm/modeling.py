@@ -133,15 +133,16 @@ class Block(nn.Module):
     config: LMConfig
 
     @nn.compact
-    def __call__(self, x: Array, positions: Array) -> Array:
+    def __call__(self, x: Array, positions: Array, deterministic: bool = True) -> Array:
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
-        x = x + CausalSelfAttention(cfg, name="attn")(
+        drop = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)
+        x = x + drop(CausalSelfAttention(cfg, name="attn")(
             RMSNorm(cfg.rmsnorm_eps, dtype, name="attn_norm")(x), positions
-        )
-        x = x + SwiGLU(cfg, name="mlp")(
+        ))
+        x = x + drop(SwiGLU(cfg, name="mlp")(
             RMSNorm(cfg.rmsnorm_eps, dtype, name="mlp_norm")(x)
-        )
+        ))
         return x
 
 
@@ -156,10 +157,15 @@ class CausalLM(nn.Module):
     config: LMConfig
 
     @nn.compact
-    def __call__(self, input_ids: Array, positions: Optional[Array] = None) -> Array:
+    def __call__(self, input_ids: Array, positions: Optional[Array] = None,
+                 deterministic: bool = True) -> Array:
         cfg = self.config
-        dtype = jnp.dtype(cfg.dtype)
         b, l = input_ids.shape
+        if l > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {l} exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        dtype = jnp.dtype(cfg.dtype)
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(l, dtype=jnp.int32), (b, l))
         embed = self.param(
@@ -168,7 +174,7 @@ class CausalLM(nn.Module):
         )
         x = embed[input_ids].astype(dtype)
         for i in range(cfg.n_layers):
-            x = Block(cfg, name=f"layer_{i}")(x, positions)
+            x = Block(cfg, name=f"layer_{i}")(x, positions, deterministic)
         x = RMSNorm(cfg.rmsnorm_eps, dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
             logits = x.astype(jnp.float32) @ embed.T

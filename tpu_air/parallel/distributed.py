@@ -193,9 +193,19 @@ def agent_loop(control_address, process_id: int) -> None:
 _AGENT_MAIN = """\
 import os, sys
 from tpu_air.parallel import distributed as D
+pid = int(os.environ["TPU_AIR_PROCESS_ID"])
+gcs = os.environ.get("TPU_AIR_GCS")
+if gcs:
+    # register with the C++ control plane + heartbeat (failure detection)
+    try:
+        from tpu_air.control import GcsClient, HeartbeatThread
+        GcsClient(gcs).register_node(f"host-{pid}", address=os.environ.get("TPU_AIR_CONTROL", ""))
+        HeartbeatThread(gcs, f"host-{pid}", interval=0.5).start()
+    except Exception as e:
+        print(f"agent {pid}: gcs registration failed: {e}", file=sys.stderr)
 D.ensure_initialized()
 host, port = os.environ["TPU_AIR_CONTROL"].rsplit(":", 1)
-D.agent_loop((host, int(port)), int(os.environ["TPU_AIR_PROCESS_ID"]))
+D.agent_loop((host, int(port)), pid)
 """
 
 
@@ -210,12 +220,28 @@ class LocalCluster:
     subprocess-free *driver script*; use `spawn_local_cluster` from a fresh
     process whose jax is not yet initialized."""
 
-    def __init__(self, server: HostAgentServer, procs: List[subprocess.Popen]):
+    def __init__(self, server: HostAgentServer, procs: List[subprocess.Popen],
+                 gcs_proc: Optional[subprocess.Popen] = None,
+                 gcs_address: Optional[str] = None):
         self.server = server
         self.procs = procs
+        self.gcs_proc = gcs_proc
+        self.gcs_address = gcs_address
+        self._gcs_client = None
 
     def run(self, fn):
         return self.server.run(fn)
+
+    def nodes(self) -> list:
+        """Cluster membership from the C++ control plane (alive = heartbeat
+        fresh) — the failure-detection view."""
+        if self.gcs_address is None:
+            return []
+        if self._gcs_client is None:
+            from tpu_air.control import GcsClient
+
+            self._gcs_client = GcsClient(self.gcs_address)
+        return self._gcs_client.list_nodes()
 
     def shutdown(self):
         self.server.shutdown()
@@ -224,6 +250,10 @@ class LocalCluster:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+        if self._gcs_client is not None:
+            self._gcs_client.close()
+        if self.gcs_proc is not None:
+            self.gcs_proc.kill()
 
 
 def spawn_local_cluster(
@@ -238,6 +268,17 @@ def spawn_local_cluster(
         pass  # best-effort; callers use a fresh process anyway
     coord_port = _free_port()
     coordinator = f"127.0.0.1:{coord_port}"
+
+    # C++ control plane: membership + heartbeats for the virtual hosts.
+    # Best-effort — a missing protobuf toolchain degrades to no GCS.
+    gcs_proc, gcs_address = None, None
+    try:
+        from tpu_air.control import GcsClient, HeartbeatThread, start_gcs
+
+        gcs_proc, gcs_port = start_gcs(dead_after_ms=3000)
+        gcs_address = f"127.0.0.1:{gcs_port}"
+    except Exception as e:
+        print(f"spawn_local_cluster: no gcs ({e})", file=sys.stderr)
 
     server = HostAgentServer(num_processes)
     host, port = server.address
@@ -256,6 +297,8 @@ def spawn_local_cluster(
         TPU_AIR_NUM_PROCESSES=str(num_processes),
         TPU_AIR_CONTROL=f"{host}:{port}",
     )
+    if gcs_address:
+        env_base["TPU_AIR_GCS"] = gcs_address
 
     procs = []
     for pid in range(1, num_processes):
@@ -276,6 +319,14 @@ def spawn_local_cluster(
     )
     os.environ["TPU_AIR_PROCESS_ID"] = "0"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    if gcs_address:
+        os.environ["TPU_AIR_GCS"] = gcs_address
+        try:
+            GcsClient(gcs_address).register_node("host-0", address=f"{host}:{port}")
+            HeartbeatThread(gcs_address, "host-0", interval=0.5).start()
+        except Exception as e:
+            print(f"spawn_local_cluster: host-0 gcs registration failed: {e}",
+                  file=sys.stderr)
     ensure_initialized()
 
     t = threading.Thread(target=server.wait_for_agents, kwargs={"timeout": timeout})
@@ -285,5 +336,7 @@ def spawn_local_cluster(
         server._listener.close()  # unblocks the accept() so the thread exits
         for p in procs:
             p.kill()
+        if gcs_proc is not None:
+            gcs_proc.kill()
         raise TimeoutError("host agents failed to connect")
-    return LocalCluster(server, procs)
+    return LocalCluster(server, procs, gcs_proc, gcs_address)
