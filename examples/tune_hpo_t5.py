@@ -1,0 +1,108 @@
+"""W2: HPO sweep over the T5 fine-tune — 4 trials, ASHA early stopping.
+
+The reference's Tuner flow (Model_finetuning_and_batch_inference.ipynb:
+cc-51-59): choice-grids over learning_rate / epochs / weight_decay,
+ASHAScheduler(max_t), metric eval_loss/min, per-trial num_workers=1 "so that
+hyperparameter tuning can run in parallel" — each trial leases its own chip
+sub-mesh from the scheduler.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import pandas as pd
+
+import tpu_air
+import tpu_air.data as tad
+from tpu_air import tune
+from tpu_air.data import BatchMapper
+from tpu_air.models.t5 import T5Config
+from tpu_air.models.tokenizer import ByteTokenizer
+from tpu_air.train import (
+    CheckpointConfig,
+    RunConfig,
+    ScalingConfig,
+    T5Trainer,
+    TrainingArguments,
+)
+
+SEQ = 32
+
+
+def make_dataset():
+    rows = [{"instruction": f"repeat w{i % 5}", "output": f"w{i % 5}"}
+            for i in range(48)]
+    return tad.from_items(rows).train_test_split(0.25)
+
+
+def full_preprocessor() -> BatchMapper:
+    def fn(df: pd.DataFrame) -> pd.DataFrame:
+        t = ByteTokenizer(model_max_length=SEQ)
+        enc = t(list(df["instruction"]), max_length=SEQ, padding="max_length",
+                truncation=True, return_tensors="np")
+        lab = t(list(df["output"]), max_length=SEQ, padding="max_length",
+                truncation=True, return_tensors="np")
+        return pd.DataFrame({"input_ids": list(enc["input_ids"]),
+                             "attention_mask": list(enc["attention_mask"]),
+                             "labels": list(lab["input_ids"])})
+
+    return BatchMapper(fn, batch_format="pandas", batch_size=4096)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=4)  # cc-52: 4 trials
+    args = ap.parse_args(argv)
+
+    tpu_air.init()
+    train_ds, eval_ds = make_dataset()
+
+    trainer = T5Trainer(
+        model_config=T5Config.tiny(vocab_size=384),
+        training_args=TrainingArguments(
+            learning_rate=2e-5, per_device_train_batch_size=2,
+            num_train_epochs=4, weight_decay=0.01,
+        ),
+        tokenizer=ByteTokenizer(model_max_length=SEQ),
+        # 1 worker/trial so trials parallelize (cc-53-54)
+        scaling_config=ScalingConfig(num_workers=1, num_chips_per_worker=1),
+        datasets={"train": train_ds, "evaluation": eval_ds},
+        run_config=RunConfig(
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=1,
+                checkpoint_score_attribute="eval_loss",
+                checkpoint_score_order="min",
+            )
+        ),
+        preprocessor=full_preprocessor(),
+    )
+
+    # the reference's choice grids (cc-57) at smoke-friendly values
+    grid = tune.Tuner(
+        trainer,
+        param_space={"trainer_init_config": {
+            "learning_rate": tune.choice([3e-3, 1e-3, 3e-4, 1e-4]),
+            "num_train_epochs": tune.choice([2, 4]),
+            "weight_decay": tune.choice([0.0, 0.01, 0.1]),
+        }},
+        tune_config=tune.TuneConfig(
+            metric="eval_loss", mode="min", num_samples=args.trials, seed=57,
+            scheduler=tune.ASHAScheduler(max_t=4, grace_period=1),
+        ),
+    ).fit()
+
+    print(f"trials: {len(grid)}  errors: {grid.num_errors}")
+    best = grid.get_best_result()
+    print(f"best eval_loss: {best.metrics['eval_loss']:.4f}")
+    print(f"best config: lr={best.config['learning_rate']}, "
+          f"epochs={best.config['num_train_epochs']}, "
+          f"wd={best.config['weight_decay']}")
+    assert best.checkpoint is not None
+    tpu_air.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

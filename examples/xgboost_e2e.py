@@ -1,0 +1,122 @@
+"""W8: end-to-end tabular ML — train → tune → batch predict → HTTP serve.
+
+The reference's Introduction_to_Ray_AI_Runtime.ipynb arc (cc-9,21,32,45,60,
+71,74) on tpu_air: NYC-taxi-shaped data → MinMaxScaler preprocessor →
+GBDTTrainer → Tuner(3 samples, eta/max_depth) → BatchPredictor(GBDTPredictor)
+→ serve.run(PredictorDeployment...bind(..., http_adapter=pandas_read_json))
+and a JSON POST against it.
+
+Offline by default: synthesizes taxi-like rows (the real dataset is an S3
+parquet the image can't reach); pass --parquet DIR to read your own.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+import numpy as np
+
+import tpu_air
+import tpu_air.data as tad
+from tpu_air.data import MinMaxScaler
+from tpu_air import serve, tune
+from tpu_air.predict import BatchPredictor, GBDTPredictor
+from tpu_air.serve import PredictorDeployment, pandas_read_json
+from tpu_air.train import GBDTTrainer
+
+SEED = 201  # reference notebook seed (Overview_of_Ray.ipynb:cc-13)
+
+
+def make_taxi_like(n: int):
+    """Synthetic big-tip classification rows shaped like the notebook's
+    engineered features (Introduction…ipynb:cc-9-21)."""
+    rng = np.random.default_rng(SEED)
+    dist = rng.gamma(2.0, 2.0, n)
+    hour = rng.integers(0, 24, n)
+    passengers = rng.integers(1, 5, n)
+    fare = 3.0 + 2.5 * dist + rng.normal(0, 1, n)
+    p = 1 / (1 + np.exp(-(0.25 * dist - 0.05 * np.abs(12 - hour))))
+    label = (rng.uniform(size=n) < p).astype(int)
+    return tad.from_items(
+        [
+            {
+                "trip_distance": float(d), "pickup_hour": int(h),
+                "passenger_count": int(c), "fare_amount": float(f),
+                "is_big_tip": int(t),
+            }
+            for d, h, c, f, t in zip(dist, hour, passengers, fare, label)
+        ]
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=2000)
+    ap.add_argument("--parquet", default=None, help="read your own dataset")
+    ap.add_argument("--port", type=int, default=8000)
+    args = ap.parse_args(argv)
+
+    tpu_air.init()
+    ds = (tad.read_parquet(args.parquet) if args.parquet
+          else make_taxi_like(args.rows))
+    train_ds, valid_ds = ds.train_test_split(0.3, shuffle=True, seed=SEED)
+    print(f"train={train_ds.count()} valid={valid_ds.count()}")
+
+    feature_cols = ["trip_distance", "pickup_hour", "passenger_count", "fare_amount"]
+    preprocessor = MinMaxScaler(columns=feature_cols)
+
+    trainer = GBDTTrainer(
+        label_column="is_big_tip",
+        params={"objective": "binary:logistic", "max_depth": 4, "eta": 0.2},
+        num_boost_round=20,
+        datasets={"train": train_ds, "valid": valid_ds},
+        preprocessor=preprocessor,
+    )
+    result = trainer.fit()
+    print(f"train metrics: { {k: round(v, 4) for k, v in result.metrics.items() if isinstance(v, float)} }")
+
+    # -- HPO sweep (cc-45: eta/max_depth search, 3 samples) ------------------
+    grid = tune.Tuner(
+        trainer,
+        param_space={"params": {"eta": tune.uniform(0.05, 0.4),
+                                "max_depth": tune.randint(2, 6)}},
+        tune_config=tune.TuneConfig(metric="valid-logloss", mode="min",
+                                    num_samples=3, seed=7),
+    ).fit()
+    best = grid.get_best_result()
+    print(f"best config: {best.config['params']}  "
+          f"valid-logloss={best.metrics['valid-logloss']:.4f}")
+
+    # -- batch predict from the best checkpoint (cc-60) ----------------------
+    bp = BatchPredictor.from_checkpoint(best.checkpoint, GBDTPredictor)
+    preds = bp.predict(valid_ds.drop_columns(["is_big_tip"]), batch_size=512)
+    df = preds.to_pandas()
+    print(f"batch predictions: {len(df)} rows, mean p={df['predictions'].mean():.3f}")
+
+    # -- online serving (cc-71,74) -------------------------------------------
+    serve.run(
+        PredictorDeployment.options(
+            name="GBDTService", num_replicas=2, route_prefix="/rayair"
+        ).bind(GBDTPredictor, best.checkpoint, http_adapter=pandas_read_json),
+        port=args.port,
+    )
+    sample = [{"trip_distance": 4.2, "pickup_hour": 18,
+               "passenger_count": 1, "fare_amount": 14.5}]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{args.port}/rayair",
+        data=json.dumps(sample).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    print(f"HTTP prediction: {out}")
+    serve.shutdown()
+    tpu_air.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

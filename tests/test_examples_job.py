@@ -23,3 +23,38 @@ def test_flan_t5_job_submit_end_to_end(tmp_path, monkeypatch):
     log = jobs.logs(job_id)
     assert st["status"] == "succeeded", f"job failed:\n{log[-3000:]}"
     assert "generated_output" in log and "generated 19 outputs" in log
+
+
+def _run_example(script, *args, timeout=500):
+    import subprocess, sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    )
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.slow
+def test_xgboost_e2e_example():
+    proc = _run_example("xgboost_e2e.py", "--rows", "400", "--port", "8217",
+                        timeout=400)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "HTTP prediction" in proc.stdout
+
+
+@pytest.mark.slow
+def test_segformer_example():
+    proc = _run_example("segformer_finetune.py", "--images", "8", "--epochs", "1")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "segmentation maps" in proc.stdout
+
+
+@pytest.mark.slow
+def test_tune_hpo_example():
+    proc = _run_example("tune_hpo_t5.py", "--trials", "2")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "best eval_loss" in proc.stdout

@@ -225,7 +225,12 @@ int main(int argc, char** argv) {
 
   for (;;) {
     int fd = ::accept(srv, nullptr, nullptr);
-    if (fd < 0) continue;
+    if (fd < 0) {
+      // back off on persistent errors (EMFILE etc.) — a bare continue
+      // would spin a core while the daemon "looks" alive
+      ::usleep(10000);
+      continue;
+    }
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     std::thread(serve_conn, &st, fd).detach();
   }

@@ -199,8 +199,9 @@ if gcs:
     # register with the C++ control plane + heartbeat (failure detection)
     try:
         from tpu_air.control import GcsClient, HeartbeatThread
-        GcsClient(gcs).register_node(f"host-{pid}", address=os.environ.get("TPU_AIR_CONTROL", ""))
-        HeartbeatThread(gcs, f"host-{pid}", interval=0.5).start()
+        ctrl = os.environ.get("TPU_AIR_CONTROL", "")
+        GcsClient(gcs).register_node(f"host-{pid}", address=ctrl)
+        HeartbeatThread(gcs, f"host-{pid}", interval=0.5, node_address=ctrl).start()
     except Exception as e:
         print(f"agent {pid}: gcs registration failed: {e}", file=sys.stderr)
 D.ensure_initialized()
@@ -222,11 +223,13 @@ class LocalCluster:
 
     def __init__(self, server: HostAgentServer, procs: List[subprocess.Popen],
                  gcs_proc: Optional[subprocess.Popen] = None,
-                 gcs_address: Optional[str] = None):
+                 gcs_address: Optional[str] = None,
+                 heartbeat: Optional[Any] = None):
         self.server = server
         self.procs = procs
         self.gcs_proc = gcs_proc
         self.gcs_address = gcs_address
+        self._heartbeat = heartbeat
         self._gcs_client = None
 
     def run(self, fn):
@@ -234,14 +237,19 @@ class LocalCluster:
 
     def nodes(self) -> list:
         """Cluster membership from the C++ control plane (alive = heartbeat
-        fresh) — the failure-detection view."""
+        fresh) — the failure-detection view.  Best-effort like the rest of
+        the GCS wiring: a dead daemon degrades to []."""
         if self.gcs_address is None:
             return []
-        if self._gcs_client is None:
-            from tpu_air.control import GcsClient
+        try:
+            if self._gcs_client is None:
+                from tpu_air.control import GcsClient
 
-            self._gcs_client = GcsClient(self.gcs_address)
-        return self._gcs_client.list_nodes()
+                self._gcs_client = GcsClient(self.gcs_address)
+            return self._gcs_client.list_nodes()
+        except (ConnectionError, OSError, RuntimeError):
+            self._gcs_client = None
+            return []
 
     def shutdown(self):
         self.server.shutdown()
@@ -250,6 +258,8 @@ class LocalCluster:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
         if self._gcs_client is not None:
             self._gcs_client.close()
         if self.gcs_proc is not None:
@@ -319,11 +329,14 @@ def spawn_local_cluster(
     )
     os.environ["TPU_AIR_PROCESS_ID"] = "0"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    heartbeat = None
     if gcs_address:
         os.environ["TPU_AIR_GCS"] = gcs_address
         try:
             GcsClient(gcs_address).register_node("host-0", address=f"{host}:{port}")
-            HeartbeatThread(gcs_address, "host-0", interval=0.5).start()
+            heartbeat = HeartbeatThread(gcs_address, "host-0", interval=0.5,
+                                        node_address=f"{host}:{port}")
+            heartbeat.start()
         except Exception as e:
             print(f"spawn_local_cluster: host-0 gcs registration failed: {e}",
                   file=sys.stderr)
@@ -338,5 +351,7 @@ def spawn_local_cluster(
             p.kill()
         if gcs_proc is not None:
             gcs_proc.kill()
+        if heartbeat is not None:
+            heartbeat.stop()
         raise TimeoutError("host agents failed to connect")
-    return LocalCluster(server, procs, gcs_proc, gcs_address)
+    return LocalCluster(server, procs, gcs_proc, gcs_address, heartbeat)
