@@ -187,6 +187,118 @@ def agent_loop(control_address, process_id: int) -> None:
 
 
 # --------------------------------------------------------------------------
+# cross-host object plane
+# --------------------------------------------------------------------------
+
+
+class ObjectPlane:
+    """Cross-host object fetch over the control plane (MULTIHOST.md §5).
+
+    Each host serves its local ObjectStore on a socket and advertises the
+    endpoint in the GCS KV (``objplane/<node_id>``); ``fetch`` resolves an
+    object's holders through the GCS object directory, pulls the serialized
+    value from one of them, and caches it in the local store — mirroring the
+    reference stack's raylet-to-raylet transfer with its "zero copy is not
+    guaranteed" cross-node caveat (Scaling_batch_inference.ipynb:cc-87-88)."""
+
+    def __init__(self, store, node_id: str, gcs_address: str):
+        from tpu_air.control import GcsClient
+
+        self.store = store
+        self.node_id = node_id
+        self.gcs = GcsClient(gcs_address)
+        self._listener = mpc.Listener(("127.0.0.1", 0), authkey=_AUTHKEY)
+        host, port = self._listener.address
+        self.address = f"{host}:{port}"
+        self.gcs.kv_put(f"objplane/{node_id}", self.address.encode())
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    # -- owner side ---------------------------------------------------------
+    def put(self, value, object_id: Optional[str] = None) -> str:
+        """Store locally and publish the location to the GCS directory."""
+        ref = self.store.put(value, object_id)
+        oid = getattr(ref, "id", object_id)
+        self.gcs.publish_object(oid, self.node_id)
+        return oid
+
+    def _serve(self) -> None:
+        from tpu_air.core import serialization
+
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                return
+
+            def handle(c):
+                try:
+                    while True:
+                        object_id = c.recv()
+                        if object_id is None:
+                            return
+                        if self.store.contains(object_id):
+                            c.send(serialization.dumps(self.store.get(object_id)))
+                        else:
+                            c.send(None)
+                except (EOFError, OSError):
+                    pass
+                finally:
+                    c.close()
+
+            threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+    # -- consumer side --------------------------------------------------------
+    def fetch(self, object_id: str):
+        """Local hit, else pull from a holder named by the GCS directory and
+        cache locally."""
+        from tpu_air.core import serialization
+
+        if self.store.contains(object_id):
+            return self.store.get(object_id)
+        loc = self.gcs.locate_object(object_id)
+        if loc is None:
+            raise KeyError(f"object {object_id} not in the cluster directory")
+        last_err: Optional[Exception] = None
+        for node_id in loc["node_ids"]:
+            if node_id == self.node_id:
+                continue
+            raw = self.gcs.kv_get(f"objplane/{node_id}")
+            if raw is None:
+                continue
+            host, port = raw.decode().rsplit(":", 1)
+            try:
+                conn = mpc.Client((host, int(port)), authkey=_AUTHKEY)
+                conn.send(object_id)
+                blob = conn.recv()
+                conn.send(None)
+                conn.close()
+            except (OSError, EOFError) as e:  # holder died — try the next one
+                last_err = e
+                continue
+            if blob is not None:
+                value = serialization.loads(blob)
+                try:  # cache for later readers on this host
+                    self.store.put(value, object_id)
+                    self.gcs.publish_object(object_id, self.node_id)
+                except Exception:
+                    pass
+                return value
+        raise KeyError(
+            f"object {object_id} unreachable from {loc['node_ids']}: {last_err}"
+        )
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.gcs.close()
+
+
+# --------------------------------------------------------------------------
 # local multi-process emulation (tests / single machine)
 # --------------------------------------------------------------------------
 
