@@ -3,18 +3,43 @@
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
 "platform": ..., "mfu": ..., ...}.
 
-Robustness contract (VERDICT r1 item 1): the injected `axon` PJRT plugin can
-fail TPU backend init with UNAVAILABLE, and a wedged init must not lose the
-round's perf artifact.  The parent process therefore never imports jax; it
-runs the measurement in a child subprocess — TPU attempt, one retry, then a
-CPU-smoke fallback with the plugin disabled — and ALWAYS exits 0 with a JSON
-line describing whichever attempt succeeded.
+Measurement core (rebuilt for round 3 — VERDICT r2 item 1):
+
+* **Slope-based timing.** The same jitted train-step scan is compiled at two
+  lengths (N and 3N steps); throughput is derived from the *difference* of the
+  two median wall times.  Any fixed per-dispatch cost (tunnel latency, host
+  sync overhead, transfer setup) appears identically in both and cancels, so
+  the slope is immune to the class of error that produced round 1's impossible
+  2,691%-of-peak number.
+* **Provably-blocking sync.** Each measured dispatch returns a checksum that
+  is data-dependent on the FULL final parameter tree
+  (``loss + 1e-20 * global_norm(params)``); fetching it to the host cannot
+  complete before every parameter update in the scan has executed.  A single
+  scalar loss is not enough — XLA may schedule the loss chain ahead of
+  parameter writes.
+* **Hard sanity gates.** The result is marked ``"measurement_valid": false``
+  (and NOT persisted as a future baseline) unless (a) the long run is
+  meaningfully longer than the short run, (b) the implied fixed overhead is
+  non-negative within noise, and (c) computed MFU lies in (0, 1].  An invalid
+  measurement is published as invalid — never silently as a headline.
+* **FLOPs from the compiler when possible.** MFU uses XLA's
+  ``compiled.cost_analysis()['flops']`` for the measured program when the
+  backend reports it, falling back to the standard ``6 * n_params * tokens``
+  dense-transformer estimate; the JSON records which source was used.
+
+Robustness contract (VERDICT r1 item 1, r2 weak 2): the injected ``axon`` PJRT
+plugin can fail TPU backend init with UNAVAILABLE or wedge for minutes.  The
+parent process never imports jax; it probes backend init in a subprocess with
+retries + backoff, runs the measurement in a child, and ALWAYS exits 0 with a
+JSON line.  When it falls back to CPU it records *why* (per-probe rc/stderr)
+in the artifact instead of silently standing in for the headline.
 
 The measured workload is the reference's W1 fine-tune contract (seq 512,
 per-device batch >= 2 — Model_finetuning_and_batch_inference.ipynb:cc-26,32)
 in the config we actually ship on TPU: bf16 activations.  Both the XLA einsum
 attention path and the Pallas flash-attention path are measured; the faster
-one is the headline number and both appear in the JSON.
+one is the headline number, and a flash failure is surfaced as
+``"flash_error"`` in the JSON rather than a silent absence.
 """
 
 from __future__ import annotations
@@ -55,40 +80,23 @@ def _count_params(tree) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
 
 
-def _dispatch_overhead():
-    """Median host->device->host round trip for a trivial jitted op.
-
-    Under the axon PJRT tunnel a dispatch costs ~70ms of wire latency and
-    jax.block_until_ready is NOT a reliable sync point (measured: a chained
-    matmul loop "finished" at 33,000 TFLOP/s).  Only a host transfer
-    (float(x)) actually waits for the device.  We measure that fixed cost so
-    the step timing can subtract it.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def tiny(a):
-        return a + 1.0
-
-    a = jnp.zeros(())
-    float(tiny(a))  # compile
-    samples = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        float(tiny(a))
-        samples.append(time.perf_counter() - t0)
-    return sorted(samples)[len(samples) // 2]
+def _compiled_flops(compiled) -> float | None:
+    """Per-execution FLOPs from XLA cost analysis, if the backend reports it."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
 
 
-def _measure_throughput(model, config, params0, batch, enc_len, dec_len, steps):
-    """Time `steps` train steps run inside ONE compiled lax.scan dispatch,
-    synced by a host transfer of the final loss; returns (tokens/sec, loss).
+def _measure_slope(model, config, params0, batch, enc_len, dec_len, steps_short, reps=3):
+    """Slope-based throughput measurement (see module docstring).
 
-    A per-step Python loop would measure dispatch latency, not device
-    throughput (block_until_ready is a no-op under the axon tunnel — see
-    _dispatch_overhead); the scan form is also the honest TPU idiom: the
-    whole measured region is one XLA program.
+    Returns a dict with tokens/sec, per-step seconds, both raw timings, the
+    validity verdict, and (when XLA reports it) compiler-counted FLOPs/step.
     """
     import jax
     import jax.numpy as jnp
@@ -102,9 +110,7 @@ def _measure_throughput(model, config, params0, batch, enc_len, dec_len, steps):
 
     from tpu_air.models.t5 import cross_entropy_loss, shift_right
 
-    params = jax.tree_util.tree_map(jnp.copy, params0)
     tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(2e-5, weight_decay=0.01))
-    opt_state = tx.init(params)
 
     def train_step(carry, _):
         p, o = carry
@@ -125,24 +131,79 @@ def _measure_throughput(model, config, params0, batch, enc_len, dec_len, steps):
 
     from functools import partial
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def run_steps(p, o):
-        (p, o), losses = jax.lax.scan(train_step, (p, o), None, length=steps)
-        return p, o, losses[-1]
+    def make_run(steps):
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def run(p, o):
+            (p, o), losses = jax.lax.scan(train_step, (p, o), None, length=steps)
+            # checksum depends on EVERY final parameter: fetching it is a
+            # complete device sync, not just a sync of the loss chain
+            checksum = losses[-1] + jnp.asarray(1e-20, losses.dtype) * optax.global_norm(p)
+            return p, o, checksum
 
-    overhead = _dispatch_overhead()
+        return run
 
-    # compile + warm up (the first transfer also faults in any lazy state)
-    params, opt_state, loss = run_steps(params, opt_state)
-    _ = float(loss)
+    steps_long = 3 * steps_short
+    params = jax.tree_util.tree_map(jnp.copy, params0)
+    opt_state = tx.init(params)
 
-    t0 = time.perf_counter()
-    params, opt_state, loss = run_steps(params, opt_state)
-    loss = float(loss)  # host transfer = the only reliable sync point
-    dt = max(time.perf_counter() - t0 - overhead, 1e-9)
+    # AOT-compile both scan lengths once; the compiled executables are used
+    # for the timed calls AND for XLA's own FLOP count of the measured program
+    run_short = make_run(steps_short).lower(params, opt_state).compile()
+    run_long = make_run(steps_long).lower(params, opt_state).compile()
+
+    flops_per_step = None
+    total = _compiled_flops(run_long)
+    if total:
+        flops_per_step = total / steps_long
+
+    def timed(run, p, o):
+        t0 = time.perf_counter()
+        p, o, checksum = run(p, o)
+        loss = float(checksum)  # host transfer of full-tree-dependent scalar
+        return time.perf_counter() - t0, loss, p, o
+
+    # compile + warm both programs (donation threads state through each call)
+    _, _, params, opt_state = timed(run_short, params, opt_state)
+    _, _, params, opt_state = timed(run_long, params, opt_state)
+
+    t_short, t_long, loss = [], [], 0.0
+    for _ in range(reps):
+        dt, loss, params, opt_state = timed(run_short, params, opt_state)
+        t_short.append(dt)
+        dt, loss, params, opt_state = timed(run_long, params, opt_state)
+        t_long.append(dt)
+
+    med_short = sorted(t_short)[len(t_short) // 2]
+    med_long = sorted(t_long)[len(t_long) // 2]
+    delta = med_long - med_short
+    per_step = delta / (steps_long - steps_short) if delta > 0 else float("nan")
+    implied_overhead = med_short - per_step * steps_short if delta > 0 else float("nan")
+
+    problems = []
+    if not (delta > 0.25 * med_long):
+        problems.append(
+            f"non-linear scaling: t({steps_long})={med_long:.4f}s vs "
+            f"t({steps_short})={med_short:.4f}s — delta too small for a real slope"
+        )
+    elif implied_overhead < -0.15 * med_short:
+        problems.append(
+            f"negative implied overhead ({implied_overhead:.4f}s) exceeds noise band"
+        )
 
     tokens_per_step = batch * (enc_len + dec_len)
-    return tokens_per_step * steps / dt, loss
+    tokens_per_sec = tokens_per_step / per_step if per_step == per_step and per_step > 0 else 0.0
+
+    return {
+        "tokens_per_sec": tokens_per_sec,
+        "per_step_s": per_step,
+        "t_short_s": [round(t, 4) for t in t_short],
+        "t_long_s": [round(t, 4) for t in t_long],
+        "steps": [steps_short, steps_long],
+        "implied_overhead_s": round(implied_overhead, 4) if implied_overhead == implied_overhead else None,
+        "flops_per_step_xla": flops_per_step,
+        "problems": problems,
+        "final_loss": loss,
+    }
 
 
 def _child_main() -> None:
@@ -157,11 +218,10 @@ def _child_main() -> None:
     if on_tpu:
         config = T5Config.flan_t5_base()
         batch, enc_len, dec_len = 32, 512, 128
-        steps = 10
     else:  # CPU smoke mode — same path, tiny dials (SURVEY.md §4.2)
         config = T5Config.tiny()
         batch, enc_len, dec_len = 8, 64, 16
-        steps = 4
+    steps_short = 4
     config.dropout_rate = 0.0
     config.dtype = "bfloat16" if on_tpu else "float32"
 
@@ -174,35 +234,51 @@ def _child_main() -> None:
     n_params = _count_params(params)
 
     results = {}
-    losses = {}
-    # einsum path (XLA attention)
-    tps, loss = _measure_throughput(model, config, params, batch, enc_len, dec_len, steps)
-    results["einsum"], losses["einsum"] = tps, loss
+    flash_error = None
+    meas = _measure_slope(model, config, params, batch, enc_len, dec_len, steps_short)
+    results["einsum"] = meas
     # flash path (Pallas kernel) — only meaningful where the kernel runs (TPU)
     if on_tpu:
         try:
             flash_config = T5Config.from_dict({**config.to_dict(), "use_flash_attention": True})
             flash_model = T5ForConditionalGeneration(flash_config)
-            tps_f, loss_f = _measure_throughput(flash_model, flash_config, params, batch, enc_len, dec_len, steps)
-            results["flash"], losses["flash"] = tps_f, loss_f
-        except Exception as e:  # a broken kernel must not kill the bench
-            print(f"flash-attention path failed: {type(e).__name__}: {e}", file=sys.stderr)
+            results["flash"] = _measure_slope(
+                flash_model, flash_config, params, batch, enc_len, dec_len, steps_short
+            )
+        except Exception as e:  # a broken kernel must not kill the bench —
+            # but it must be VISIBLE in the artifact (VERDICT r2 weak 3)
+            flash_error = f"{type(e).__name__}: {e}"
+            print(f"flash-attention path failed: {flash_error}", file=sys.stderr)
 
-    best_path = max(results, key=results.get)
-    value = results[best_path]
-    loss = losses[best_path]
+    valid_paths = {k: m for k, m in results.items() if not m["problems"]}
+    pool = valid_paths or results
+    best_path = max(pool, key=lambda k: pool[k]["tokens_per_sec"])
+    best = results[best_path]
+    value = best["tokens_per_sec"]
 
-    # Training-step FLOPs estimate: fwd+bwd ~= 6 * n_params * tokens
-    # (standard dense-transformer accounting; attention score FLOPs omitted).
-    flops_per_step = 6.0 * n_params * batch * (enc_len + dec_len)
-    peak = _peak_flops(dev.device_kind) if on_tpu else None
+    # FLOPs/step: prefer the XLA-counted number for the measured program;
+    # fall back to the standard 6 * n_params * tokens dense estimate.
     tokens_per_step = batch * (enc_len + dec_len)
+    if best["flops_per_step_xla"]:
+        flops_per_step = best["flops_per_step_xla"]
+        flops_source = "xla_cost_analysis"
+    else:
+        flops_per_step = 6.0 * n_params * tokens_per_step
+        flops_source = "6ND_estimate"
+    peak = _peak_flops(dev.device_kind) if on_tpu else None
     mfu = (value / tokens_per_step) * flops_per_step / peak if peak else None
+
+    problems = list(best["problems"])
+    if mfu is not None and not (0.0 < mfu <= 1.0):
+        problems.append(
+            f"mfu={mfu:.4f} outside (0, 1] — physically impossible, sync or peak-FLOPs error"
+        )
+    measurement_valid = not problems
 
     metric = f"flan-t5-{'base' if on_tpu else 'tiny'} fine-tune throughput ({platform})"
     vs_baseline = 1.0
     prev = _load_last().get(metric)
-    if prev and prev.get("value"):
+    if prev and prev.get("value") and measurement_valid:
         # only comparable against the same metric (model size + platform are
         # encoded in the metric string) — a CPU-fallback round must not
         # clobber the comparison for the next TPU round
@@ -217,14 +293,39 @@ def _child_main() -> None:
         "device_kind": dev.device_kind,
         "n_params": n_params,
         "attention_path": best_path,
-        "tokens_per_sec": {k: round(v, 2) for k, v in results.items()},
+        "tokens_per_sec": {k: round(m["tokens_per_sec"], 2) for k, m in results.items()},
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_step": flops_per_step,
+        "flops_source": flops_source,
+        "measurement_valid": measurement_valid,
+        "problems": problems,
+        "timing": {
+            k: {
+                "steps": m["steps"],
+                "t_short_s": m["t_short_s"],
+                "t_long_s": m["t_long_s"],
+                "per_step_s": round(m["per_step_s"], 5) if m["per_step_s"] == m["per_step_s"] else None,
+                "implied_overhead_s": m["implied_overhead_s"],
+                # per-path gate verdict: a non-headline path that failed its
+                # gates must be visibly marked, not published as a bare number
+                "valid": not m["problems"],
+                "problems": m["problems"],
+            }
+            for k, m in results.items()
+        },
         "batch": batch,
         "enc_len": enc_len,
         "dec_len": dec_len,
         "dtype": config.dtype,
-        "final_loss": round(loss, 4),
+        # NaN is not valid strict JSON — a diverged loss must not corrupt the
+        # one-line artifact contract
+        "final_loss": round(best["final_loss"], 4) if best["final_loss"] == best["final_loss"] else None,
     }
+    if best["final_loss"] != best["final_loss"]:
+        result["problems"] = problems + ["final loss is NaN (diverged run)"]
+        result["measurement_valid"] = False
+    if flash_error:
+        result["flash_error"] = flash_error
     print(json.dumps(result), flush=True)
 
 
@@ -242,27 +343,24 @@ def _load_last() -> dict:
 
 
 def _run_child(env: dict, timeout: float):
-    """Run the measurement subprocess; return the parsed JSON result or None."""
+    """Run the measurement subprocess; return (parsed JSON result or None, note)."""
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child"],
             env=env, cwd=_HERE, capture_output=True, text=True, timeout=timeout,
         )
     except subprocess.TimeoutExpired:
-        print("bench child timed out", file=sys.stderr)
-        return None
+        return None, f"bench child timed out after {timeout:.0f}s"
     if proc.stderr:
         sys.stderr.write(proc.stderr[-4000:])
     for line in reversed(proc.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                return json.loads(line), None
             except json.JSONDecodeError:
                 continue
-    if proc.returncode != 0:
-        print(f"bench child rc={proc.returncode}", file=sys.stderr)
-    return None
+    return None, f"bench child rc={proc.returncode}, stderr tail: {proc.stderr[-500:]!r}"
 
 
 def _cpu_env() -> dict:
@@ -271,34 +369,73 @@ def _cpu_env() -> dict:
     return cpu_env()
 
 
-def _probe_backend(env: dict, timeout: float) -> bool:
-    """Cheap check that jax backend init completes (the axon plugin can hang
-    for minutes rather than failing fast — probe before committing to a full
-    measurement run)."""
+def _probe_backend(env: dict, timeout: float):
+    """Check that jax backend init completes (the axon plugin can hang for
+    minutes rather than failing fast — probe before committing to a full
+    measurement run).  Returns (ok, info-dict recording why it failed)."""
+    t0 = time.time()
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
             env=env, capture_output=True, text=True, timeout=timeout,
         )
-        return proc.returncode == 0
+        info = {
+            "rc": proc.returncode,
+            "elapsed_s": round(time.time() - t0, 1),
+            "platform": proc.stdout.strip() or None,
+        }
+        if proc.returncode != 0:
+            info["stderr_tail"] = proc.stderr[-500:]
+        return proc.returncode == 0, info
     except subprocess.TimeoutExpired:
-        return False
+        return False, {"rc": None, "elapsed_s": round(time.time() - t0, 1),
+                       "error": f"probe timed out after {timeout:.0f}s"}
 
 
 def main() -> None:
-    probe_timeout = float(os.environ.get("TPU_AIR_BENCH_PROBE_TIMEOUT", "240"))
-    run_timeout = float(os.environ.get("TPU_AIR_BENCH_TIMEOUT", "1800"))
+    probe_timeout = float(os.environ.get("TPU_AIR_BENCH_PROBE_TIMEOUT", "300"))
+    probe_attempts = int(os.environ.get("TPU_AIR_BENCH_PROBE_ATTEMPTS", "4"))
+    probe_backoff = float(os.environ.get("TPU_AIR_BENCH_PROBE_BACKOFF", "45"))
+    run_timeout = float(os.environ.get("TPU_AIR_BENCH_TIMEOUT", "2400"))
+    # aggregate wall-clock budget: probes are cheap, but a measurement child
+    # that passes the probe then wedges mid-run costs a full run_timeout — cap
+    # the whole TPU phase so repeated wedges can't eat the round
+    deadline = time.time() + float(os.environ.get("TPU_AIR_BENCH_DEADLINE", "3900"))
+    full_runs = 0
     result = None
-    # attempt 1+2: whatever backend the environment resolves (TPU when live),
-    # gated on a short backend-init probe so a wedged tunnel can't eat the round
-    for _ in range(2):
-        if _probe_backend(dict(os.environ), timeout=probe_timeout):
-            result = _run_child(dict(os.environ), timeout=run_timeout)
+    attempts_log = []
+    # TPU attempts: the plugin is known to wedge intermittently, so budget
+    # several probes with backoff rather than giving up after two quick tries
+    # (VERDICT r2 weak 2) and keep a log of every failure for the artifact.
+    for i in range(probe_attempts):
+        if time.time() > deadline:
+            attempts_log.append({"stage": "budget", "error": "aggregate bench deadline exceeded"})
+            break
+        ok, info = _probe_backend(dict(os.environ), timeout=probe_timeout)
+        info["stage"] = "probe"
+        attempts_log.append(info)
+        if ok:
+            if full_runs >= 2:  # at most two full measurement attempts
+                attempts_log.append({"stage": "budget", "error": "full-run retry budget exhausted"})
+                break
+            full_runs += 1
+            result, note = _run_child(
+                dict(os.environ), timeout=min(run_timeout, max(deadline - time.time(), 60))
+            )
             if result:
                 break
-    # fallback: CPU smoke with the TPU plugin disabled — never lose the artifact
+            attempts_log.append({"stage": "run", "error": note})
+        if i + 1 < probe_attempts:
+            time.sleep(probe_backoff)
+    # fallback: CPU smoke with the TPU plugin disabled — never lose the
+    # artifact, but record exactly why the headline platform was missed
     if not result:
-        result = _run_child(_cpu_env(), timeout=900)
+        result, note = _run_child(_cpu_env(), timeout=900)
+        if result:
+            result["fallback_reason"] = {
+                "note": "TPU backend unavailable; CPU smoke stands in",
+                "attempts": attempts_log,
+            }
     if not result:
         result = {
             "metric": "bench-harness-failure",
@@ -306,9 +443,12 @@ def main() -> None:
             "unit": "tokens/sec/chip",
             "vs_baseline": 0.0,
             "platform": "none",
+            "fallback_reason": {"attempts": attempts_log, "cpu_note": note},
         }
-    else:
-        # record per-metric so a fallback run never destroys a TPU baseline
+    elif result.get("measurement_valid", True):
+        # record per-metric so a fallback run never destroys a TPU baseline;
+        # an INVALID measurement is published in the round artifact but never
+        # persisted as a future comparison point
         try:
             last = _load_last()
             last[result["metric"]] = result

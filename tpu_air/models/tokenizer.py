@@ -84,10 +84,13 @@ class ByteTokenizer:
     def decode(self, ids, skip_special_tokens: bool = True) -> str:
         data = bytearray()
         for i in np.asarray(ids).tolist():
-            if i >= self.OFFSET:
+            if self.OFFSET <= i < self.OFFSET + 256:
                 data.append(i - self.OFFSET)
             elif not skip_special_tokens:
                 data.extend(f"<{i}>".encode())
+            # ids beyond the byte range (model vocab padded past 256+OFFSET,
+            # reachable from an untrained head) decode to nothing, like HF's
+            # handling of out-of-vocab pieces
         return data.decode("utf-8", errors="replace")
 
     def batch_decode(self, batch, skip_special_tokens: bool = True) -> List[str]:
