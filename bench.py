@@ -45,6 +45,7 @@ one is the headline number, and a flash failure is surfaced as
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -317,12 +318,12 @@ def _child_main() -> None:
         "enc_len": enc_len,
         "dec_len": dec_len,
         "dtype": config.dtype,
-        # NaN is not valid strict JSON — a diverged loss must not corrupt the
-        # one-line artifact contract
-        "final_loss": round(best["final_loss"], 4) if best["final_loss"] == best["final_loss"] else None,
+        # NaN/Infinity are not valid strict JSON — a diverged loss must not
+        # corrupt the one-line artifact contract
+        "final_loss": round(best["final_loss"], 4) if math.isfinite(best["final_loss"]) else None,
     }
-    if best["final_loss"] != best["final_loss"]:
-        result["problems"] = problems + ["final loss is NaN (diverged run)"]
+    if not math.isfinite(best["final_loss"]):
+        result["problems"] = problems + ["final loss is non-finite (diverged run)"]
         result["measurement_valid"] = False
     if flash_error:
         result["flash_error"] = flash_error

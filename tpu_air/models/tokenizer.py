@@ -86,7 +86,7 @@ class ByteTokenizer:
         for i in np.asarray(ids).tolist():
             if self.OFFSET <= i < self.OFFSET + 256:
                 data.append(i - self.OFFSET)
-            elif not skip_special_tokens:
+            elif i < self.OFFSET and not skip_special_tokens:
                 data.extend(f"<{i}>".encode())
             # ids beyond the byte range (model vocab padded past 256+OFFSET,
             # reachable from an untrained head) decode to nothing, like HF's

@@ -27,6 +27,12 @@ class ScalingConfig:
     num_workers: int = 1
     use_tpu: bool = True
     num_chips_per_worker: int = 1
+    # Tensor-parallel degree: each data-parallel worker's model is sharded
+    # over this many chips (the ``model`` mesh axis; rules in
+    # tpu_air/parallel/sharding.py).  The reference has no TP (SURVEY.md §2C)
+    # but the north-star FLAN-T5-XL cannot run replicated — TP is a config
+    # change here, per SURVEY.md §7's mesh stance.
+    model_parallel: Optional[int] = None
     topology: Optional[str] = None  # e.g. "v4-32"; informational for placement
     resources_per_worker: Optional[Dict[str, float]] = None
     # GPU-era alias accepted for drop-in compatibility (cc-40's use_gpu=True)
@@ -35,6 +41,18 @@ class ScalingConfig:
     def __post_init__(self):
         if self.use_gpu is not None:
             self.use_tpu = bool(self.use_gpu)
+        if self.model_parallel is not None:
+            if self.model_parallel < 1:
+                raise ValueError("model_parallel must be >= 1")
+            if self.num_chips_per_worker == 1:
+                self.num_chips_per_worker = self.model_parallel
+            elif self.num_chips_per_worker % self.model_parallel != 0:
+                raise ValueError(
+                    f"num_chips_per_worker={self.num_chips_per_worker} is not a "
+                    f"multiple of model_parallel={self.model_parallel}"
+                )
+        else:
+            self.model_parallel = 1
 
     @property
     def total_chips(self) -> int:

@@ -178,6 +178,7 @@ class BaseTrainer:
         if extra_config:
             config.update(extra_config)
         config["_preprocessor"] = self.preprocessor
+        config["_scaling_config"] = sc  # mesh topology source for the loop
         attempt = 0
         while True:
             if resume is not None:

@@ -146,6 +146,7 @@ class Tuner:
             tid = f"{name}_trial_{i:05d}"
             cfg = self._trial_config(s)
             cfg["_preprocessor"] = self._trainer.preprocessor
+            cfg["_scaling_config"] = sc  # trial mesh topology (dp x tp)
             if self._trainer.resume_from_checkpoint is not None:
                 resume = self._trainer.resume_from_checkpoint
                 cfg["resume_from_checkpoint"] = (
