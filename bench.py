@@ -274,6 +274,8 @@ def _child_main() -> None:
         problems.append(
             f"mfu={mfu:.4f} outside (0, 1] — physically impossible, sync or peak-FLOPs error"
         )
+    if not math.isfinite(best["final_loss"]):
+        problems.append("final loss is non-finite (diverged run)")
     measurement_valid = not problems
 
     metric = f"flan-t5-{'base' if on_tpu else 'tiny'} fine-tune throughput ({platform})"
@@ -322,9 +324,6 @@ def _child_main() -> None:
         # corrupt the one-line artifact contract
         "final_loss": round(best["final_loss"], 4) if math.isfinite(best["final_loss"]) else None,
     }
-    if not math.isfinite(best["final_loss"]):
-        result["problems"] = problems + ["final loss is non-finite (diverged run)"]
-        result["measurement_valid"] = False
     if flash_error:
         result["flash_error"] = flash_error
     print(json.dumps(result), flush=True)

@@ -330,6 +330,17 @@ class Runtime:
         self._next_worker_id = itertools.count()
         self._stop = threading.Event()
         self._wakeup_r, self._wakeup_w = mp.Pipe(duplex=False)
+        # Worker-process spawns (forkserver first spin-up imports jax/pandas,
+        # seconds) run on a dedicated placement thread so the listener thread
+        # never blocks — done/submit messages from all workers must keep
+        # flowing while an actor is being placed.
+        self._placement_event = threading.Event()
+        self._spawn_requests = 0
+        self._to_spawn: List[tuple] = []  # claimed creations awaiting spawn
+        self._placement_thread = threading.Thread(
+            target=self._placement_loop, daemon=True
+        )
+        self._placement_thread.start()
         self._listener = threading.Thread(target=self._listen, daemon=True)
         self._listener.start()
         self._min_idle = min(2, self.num_cpus)
@@ -516,9 +527,33 @@ class Runtime:
             self.queue.append(spec)
         self._schedule()
 
+    def _placement_loop(self):
+        """Dedicated thread for anything that spawns worker processes:
+        queued-actor placement and deadlock-avoidance spawns.  Fed by
+        ``_placement_event`` from ``_schedule`` (which may run on the
+        listener thread and must never block on a process spawn)."""
+        while not self._stop.is_set():
+            self._placement_event.wait(timeout=0.2)
+            if self._stop.is_set():
+                return
+            self._placement_event.clear()
+            try:
+                self._place_queued_actors()
+                with self.lock:
+                    n = self._spawn_requests
+                    self._spawn_requests = 0
+                for _ in range(n):
+                    self._spawn_worker()
+                if n:
+                    self._schedule()  # fresh workers can take queued tasks
+            except Exception:  # noqa: BLE001 - placement must survive
+                traceback.print_exc(file=sys.stderr)
+
     def _schedule(self):
         spawn_needed = 0
-        self._place_queued_actors()
+        # claim actor resources FIRST (fast, synchronous) so queued tasks
+        # can't outrace a queued actor lease; only the spawn is deferred
+        self._claim_queued_actors()
         with self.lock:
             remaining: List[_TaskSpec] = []
             idle = [
@@ -552,8 +587,10 @@ class Runtime:
             stuck = [s for s in remaining if s.from_worker and self._can_fit(s.resources)]
             if stuck and not idle:
                 spawn_needed = min(len(stuck), 4)
-        for _ in range(spawn_needed):
-            self._spawn_worker()
+        if spawn_needed:
+            with self.lock:
+                self._spawn_requests = max(self._spawn_requests, spawn_needed)
+            self._placement_event.set()
 
     # -- actors --------------------------------------------------------------
     def create_actor(
@@ -616,29 +653,44 @@ class Runtime:
             self.pending_actors[actor_id] = rec
         self._schedule()
 
-    def _place_queued_actors(self):
-        """Dispatch queued actor creations whose resources now fit.
-
-        Strict FIFO: if the head of the queue doesn't fit, later (smaller)
-        requests do NOT jump it — large chip leases must not be starved by a
-        stream of small actors."""
-        while True:
-            with self.lock:
-                if not self.actor_queue:
-                    return
+    def _claim_queued_actors(self):
+        """FAST phase, runs synchronously inside ``_schedule`` (any thread):
+        claim resources for queued actor creations that now fit, in strict
+        FIFO — if the head of the queue doesn't fit, later (smaller) requests
+        do NOT jump it, and because the claim happens before ``_schedule``
+        dispatches tasks, a stream of chip tasks cannot outrace a queued
+        chip lease either.  The slow process spawn is handed to the
+        placement thread via ``_to_spawn``."""
+        claimed = False
+        with self.lock:
+            while self.actor_queue:
                 rec = self.actor_queue[0]
                 if not self._can_fit(rec["resources"]):
-                    return
+                    break
                 self.actor_queue.pop(0)
                 self._acquire(rec["resources"])
                 nchips = int(rec["resources"].get("chip", 0))
                 chip_ids = [self.free_chips.pop(0) for _ in range(nchips)]
+                self._to_spawn.append((rec, chip_ids))
+                claimed = True
+        if claimed:
+            self._placement_event.set()
+
+    def _place_queued_actors(self):
+        """SLOW phase (placement thread only): spawn a worker process for
+        each claimed creation and register the actor."""
+        while True:
+            with self.lock:
+                if not self._to_spawn:
+                    return
+                rec, chip_ids = self._to_spawn.pop(0)
             worker = self._spawn_worker(actor_id=rec["actor_id"])
             with self.lock:
-                if rec.get("cancelled"):
+                if rec.get("cancelled") or self._stop.is_set():
                     # kill_actor() cancelled this creation while we were
-                    # spawning (lock released around the process spawn) — the
-                    # error sentinel is already in the store; undo the
+                    # spawning (lock released around the process spawn), or
+                    # the runtime is shutting down and must not register a
+                    # worker after shutdown() cleared the table — undo the
                     # placement so nothing leaks
                     self._release(rec["resources"])
                     self.free_chips.extend(chip_ids)
@@ -732,6 +784,13 @@ class Runtime:
                 )
             )
 
+    def actor_pending_placement(self, actor_id: str) -> bool:
+        """True while the actor's creation is still queued for resources
+        (no lease claimed yet).  Once False, the actor owns its lease and
+        only construction time separates it from serving calls."""
+        with self.lock:
+            return any(r["actor_id"] == actor_id for r in self.actor_queue)
+
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         with self.lock:
             rec = self.pending_actors.pop(actor_id, None)
@@ -822,8 +881,10 @@ class Runtime:
     # -- lifecycle -------------------------------------------------------------
     def shutdown(self):
         self._stop.set()
+        self._placement_event.set()  # wake the placement thread to exit
         self._poke_listener()
         self._listener.join(timeout=2)
+        self._placement_thread.join(timeout=2)
         with self.lock:
             workers = list(self.workers.values())
             self.workers.clear()

@@ -354,8 +354,10 @@ class Dataset:
             while idx < len(pending) and pool.has_free():
                 pool.submit(submit, pending[idx])
                 idx += 1
+            scale_blocked = False
+
             def can_scale() -> bool:
-                if pool.size() >= max_size:
+                if scale_blocked or pool.size() >= max_size:
                     return False
                 # grow only while there's enough queued work to keep the
                 # bigger pool busy (>= 2 blocks per actor) — spinning up an
@@ -365,12 +367,54 @@ class Dataset:
                 if not chips:
                     return True
                 # A chip-leased scale-up actor queues for a lease the pool's
-                # own actors may hold until THIS map_batches ends — submitting
-                # a block to it would deadlock the ordered get_next loop.
-                # Only grow when the scheduler has a free lease right now.
+                # own actors may hold until THIS map_batches ends.  The free-
+                # lease check below is advisory (a concurrent consumer can
+                # take the chip between check and placement), so placement is
+                # CONFIRMED via the ready() probe before any block is
+                # submitted to the new actor.
                 from tpu_air.core.runtime import get_runtime
 
                 return get_runtime().avail.get("chip", 0.0) >= float(chips)
+
+            def try_scale_up():
+                """Create an actor and submit to it only once its placement
+                is confirmed; an actor stuck queued behind the pool's own
+                leases is killed and scaling stops (fall back to the
+                existing pool) — never feed the ordered, timeout-less
+                get_next() an actor that may never be placed."""
+                nonlocal scale_blocked
+                import time as _time
+
+                from tpu_air.core import get, kill
+                from tpu_air.core.runtime import get_runtime
+
+                a = make_actor()
+                # Phase 1: bounded wait for the LEASE.  The free-lease check
+                # in can_scale is advisory (TOCTOU) — a concurrent consumer
+                # may have taken the chip, leaving this creation queued
+                # behind leases our own pool holds until map_batches ends.
+                rt = get_runtime()
+                deadline = _time.monotonic() + 5.0
+                while rt.actor_pending_placement(a._actor_id):
+                    if _time.monotonic() > deadline:
+                        kill(a)
+                        scale_blocked = True
+                        return False
+                    _time.sleep(0.02)
+                # Phase 2: lease claimed — construction may legitimately be
+                # slow (heavy model load is what _MapWorker exists for), so
+                # no timeout here.  A crashed constructor resolves the ready
+                # ref with an error sentinel, so this cannot hang.
+                try:
+                    if a._ready_ref is not None:
+                        get(a._ready_ref)
+                except Exception:
+                    kill(a)
+                    scale_blocked = True
+                    return False
+                actors.append(a)
+                pool.push(a)
+                return True
 
             for _ in range(len(pending)):
                 # Autoscale under backlog: all actors busy and blocks still
@@ -378,9 +422,8 @@ class Dataset:
                 # (Scaling_batch_inference.ipynb:cc-4 "autoscaling the actor
                 # pool").
                 while idx < len(pending) and not pool.has_free() and can_scale():
-                    a = make_actor()
-                    actors.append(a)
-                    pool.push(a)
+                    if not try_scale_up():
+                        break
                     pool.submit(submit, pending[idx])
                     idx += 1
                 out_refs.append(put(pool.get_next()))

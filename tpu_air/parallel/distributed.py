@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import multiprocessing.connection as mpc
 import os
+import re
+import secrets
 import socket
 import subprocess
 import sys
@@ -39,7 +41,32 @@ import time
 import traceback
 from typing import Any, Callable, List, Optional
 
-_AUTHKEY = b"tpu_air-multihost"
+def _authkey() -> bytes:
+    """Per-cluster control-plane authkey.  The launcher generates a random
+    key and distributes it via the job env contract (TPU_AIR_AUTHKEY); a
+    compiled-in constant would be remote code execution for anyone who can
+    reach a non-loopback HostAgentServer.  The static fallback only covers
+    single-host loopback emulation with no launcher."""
+    key = os.environ.get("TPU_AIR_AUTHKEY")
+    return key.encode() if key else b"tpu_air-local-loopback"
+
+
+def _routable_host(toward: Optional[str]) -> str:
+    """The local address other hosts can reach us at: the source address of
+    a route toward the coordinator/GCS.  Stays 127.0.0.1 in single-host
+    emulation (where the coordinator itself is loopback)."""
+    target = (toward or "").split(":")[0] or "127.0.0.1"
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((target, 1))  # no packets sent; just picks a route
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
 _initialized = False
 
 
@@ -114,7 +141,7 @@ class HostAgentServer:
     def __init__(self, num_processes: int, address: Optional[tuple] = None):
         self.num_processes = num_processes
         addr = address or ("127.0.0.1", 0)
-        self._listener = mpc.Listener(addr, authkey=_AUTHKEY)
+        self._listener = mpc.Listener(addr, authkey=_authkey())
         self.address = self._listener.address
         self._conns: dict[int, Any] = {}
 
@@ -176,7 +203,7 @@ def agent_loop(control_address, process_id: int) -> None:
     import cloudpickle
 
     conn = mpc.Client(tuple(control_address) if isinstance(control_address, list)
-                      else control_address, authkey=_AUTHKEY)
+                      else control_address, authkey=_authkey())
     conn.send(process_id)
     while True:
         kind, payload = conn.recv()
@@ -207,7 +234,12 @@ class ObjectPlane:
         self.store = store
         self.node_id = node_id
         self.gcs = GcsClient(gcs_address)
-        self._listener = mpc.Listener(("127.0.0.1", 0), authkey=_AUTHKEY)
+        # Advertise an address other hosts can actually reach: bind the
+        # interface that routes toward the GCS (loopback only when the GCS
+        # itself is loopback, i.e. single-host emulation) — advertising
+        # 127.0.0.1 cluster-wide would make every remote fetch a KeyError.
+        bind_host = _routable_host(gcs_address)
+        self._listener = mpc.Listener((bind_host, 0), authkey=_authkey())
         host, port = self._listener.address
         self.address = f"{host}:{port}"
         self.gcs.kv_put(f"objplane/{node_id}", self.address.encode())
@@ -269,7 +301,7 @@ class ObjectPlane:
                 continue
             host, port = raw.decode().rsplit(":", 1)
             try:
-                conn = mpc.Client((host, int(port)), authkey=_AUTHKEY)
+                conn = mpc.Client((host, int(port)), authkey=_authkey())
                 conn.send(object_id)
                 blob = conn.recv()
                 conn.send(None)
@@ -402,17 +434,25 @@ def spawn_local_cluster(
     except Exception as e:
         print(f"spawn_local_cluster: no gcs ({e})", file=sys.stderr)
 
+    # per-cluster random control-plane key (see _authkey): must land in OUR
+    # env BEFORE HostAgentServer binds its listener so driver and agents agree
+    os.environ.setdefault("TPU_AIR_AUTHKEY", secrets.token_hex(16))
+
     server = HostAgentServer(num_processes)
     host, port = server.address
 
     env_base = dict(os.environ)
     env_base.pop("PALLAS_AXON_POOL_IPS", None)  # never let agents touch the TPU tunnel
+    # strip ANY inherited device-count flag (not just the test default of 8) —
+    # two conflicting flags in a child's XLA_FLAGS is an init-time error
+    inherited_xla = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env_base.get("XLA_FLAGS", ""),
+    ).strip()
     env_base.update(
         JAX_PLATFORMS="cpu",
         XLA_FLAGS=(
-            env_base.get("XLA_FLAGS", "").replace(
-                "--xla_force_host_platform_device_count=8", ""
-            ).strip()
+            inherited_xla
             + f" --xla_force_host_platform_device_count={devices_per_process}"
         ).strip(),
         TPU_AIR_COORDINATOR=coordinator,
