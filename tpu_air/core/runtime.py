@@ -684,7 +684,25 @@ class Runtime:
                 if not self._to_spawn:
                     return
                 rec, chip_ids = self._to_spawn.pop(0)
-            worker = self._spawn_worker(actor_id=rec["actor_id"])
+            try:
+                worker = self._spawn_worker(actor_id=rec["actor_id"])
+            except Exception as e:  # noqa: BLE001 - spawn failure (EAGAIN/OOM)
+                # the claim already happened — it MUST be rolled back and the
+                # ready ref resolved, or callers blocked on the actor (some
+                # deliberately without timeout) hang forever on a leaked lease
+                with self.lock:
+                    self._release(rec["resources"])
+                    self.free_chips.extend(chip_ids)
+                    self.pending_actors.pop(rec["actor_id"], None)
+                self.store.put(
+                    _ErrorSentinel(
+                        f"ActorPlacementFailed(actor={rec['actor_id']})",
+                        f"worker spawn failed: {type(e).__name__}: {e}",
+                    ),
+                    rec["ready_id"],
+                )
+                self._notify_objects()
+                continue
             with self.lock:
                 if rec.get("cancelled") or self._stop.is_set():
                     # kill_actor() cancelled this creation while we were
