@@ -152,10 +152,22 @@ def _measure_slope(model, config, params0, batch, enc_len, dec_len, steps_short,
     run_short = make_run(steps_short).lower(params, opt_state).compile()
     run_long = make_run(steps_long).lower(params, opt_state).compile()
 
-    flops_per_step = None
-    total = _compiled_flops(run_long)
-    if total:
-        flops_per_step = total / steps_long
+    # XLA's cost model on TPU counts a lax.scan body ONCE regardless of trip
+    # count (verified empirically: an N=4 and an N=12 scan of the same matmul
+    # both report exactly one matmul's flops).  Disambiguate by comparing the
+    # two compiled lengths: if the totals scale with the trip count the
+    # backend counts iterations (slope gives per-step); if they're ~equal the
+    # total IS the per-step body cost.
+    flops_per_step = flops_source_detail = None
+    total_long = _compiled_flops(run_long)
+    total_short = _compiled_flops(run_short)
+    if total_long and total_short:
+        if total_long - total_short > 0.5 * total_short:
+            flops_per_step = (total_long - total_short) / (steps_long - steps_short)
+            flops_source_detail = "xla_cost_analysis_slope"
+        else:
+            flops_per_step = total_long
+            flops_source_detail = "xla_cost_analysis_body_once"
 
     def timed(run, p, o):
         t0 = time.perf_counter()
@@ -202,6 +214,7 @@ def _measure_slope(model, config, params0, batch, enc_len, dec_len, steps_short,
         "steps": [steps_short, steps_long],
         "implied_overhead_s": round(implied_overhead, 4) if implied_overhead == implied_overhead else None,
         "flops_per_step_xla": flops_per_step,
+        "flops_xla_detail": flops_source_detail,
         "problems": problems,
         "final_loss": loss,
     }
@@ -260,11 +273,12 @@ def _child_main() -> None:
     # FLOPs/step: prefer the XLA-counted number for the measured program;
     # fall back to the standard 6 * n_params * tokens dense estimate.
     tokens_per_step = batch * (enc_len + dec_len)
+    flops_6nd = 6.0 * n_params * tokens_per_step
     if best["flops_per_step_xla"]:
         flops_per_step = best["flops_per_step_xla"]
-        flops_source = "xla_cost_analysis"
+        flops_source = best.get("flops_xla_detail") or "xla_cost_analysis"
     else:
-        flops_per_step = 6.0 * n_params * tokens_per_step
+        flops_per_step = flops_6nd
         flops_source = "6ND_estimate"
     peak = _peak_flops(dev.device_kind) if on_tpu else None
     mfu = (value / tokens_per_step) * flops_per_step / peak if peak else None
@@ -273,6 +287,14 @@ def _child_main() -> None:
     if mfu is not None and not (0.0 < mfu <= 1.0):
         problems.append(
             f"mfu={mfu:.4f} outside (0, 1] — physically impossible, sync or peak-FLOPs error"
+        )
+    # cross-check the two FLOP accountings: 6ND overestimates an enc-dec
+    # model by up to ~3x (each token only traverses its half of the network),
+    # so a ratio far outside that band means one of the counts is wrong
+    if flops_source != "6ND_estimate" and not (0.1 <= flops_per_step / flops_6nd <= 3.0):
+        problems.append(
+            f"xla flops/step {flops_per_step:.3e} vs 6ND {flops_6nd:.3e}: "
+            "ratio outside plausible band — flop accounting suspect"
         )
     if not math.isfinite(best["final_loss"]):
         problems.append("final loss is non-finite (diverged run)")
@@ -299,6 +321,7 @@ def _child_main() -> None:
         "tokens_per_sec": {k: round(m["tokens_per_sec"], 2) for k, m in results.items()},
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_step": flops_per_step,
+        "flops_per_step_6nd": flops_6nd,
         "flops_source": flops_source,
         "measurement_valid": measurement_valid,
         "problems": problems,

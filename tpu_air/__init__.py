@@ -36,6 +36,7 @@ from tpu_air.core import (
     init,
     is_initialized,
     kill,
+    nodes,
     put,
     remote,
     shutdown,
@@ -79,6 +80,7 @@ __all__ = [
     "init",
     "is_initialized",
     "kill",
+    "nodes",
     "put",
     "remote",
     "shutdown",

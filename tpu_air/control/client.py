@@ -183,11 +183,13 @@ class HeartbeatThread(threading.Thread):
         self.node_address = node_address
         self.num_chips = num_chips
         self.interval = interval
-        self._stop = threading.Event()
+        # NB: must not be named _stop — that shadows Thread._stop and
+        # makes threading._after_fork() blow up in forked children
+        self._stop_evt = threading.Event()
 
     def run(self):
         client = None
-        while not self._stop.wait(self.interval):
+        while not self._stop_evt.wait(self.interval):
             try:
                 if client is None:
                     client = GcsClient(self.address)
@@ -207,4 +209,4 @@ class HeartbeatThread(threading.Thread):
             client.close()
 
     def stop(self):
-        self._stop.set()
+        self._stop_evt.set()

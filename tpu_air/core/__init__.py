@@ -1,7 +1,7 @@
 """tpu_air.core — the task/actor/object runtime (L1)."""
 
 from .actor_pool import ActorPool
-from .api import get, put, wait
+from .api import get, nodes, put, wait
 from .object_store import ObjectRef
 from .remote import ActorClass, ActorHandle, ActorMethod, RemoteFunction, kill, remote
 from .runtime import (
@@ -31,6 +31,7 @@ __all__ = [
     "init",
     "is_initialized",
     "kill",
+    "nodes",
     "put",
     "remote",
     "shutdown",

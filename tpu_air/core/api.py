@@ -33,6 +33,13 @@ def get(ref, timeout: Optional[float] = None):
     return rt.get_runtime().get(ref, timeout=timeout)
 
 
+def nodes() -> List[dict]:
+    """Cluster membership with heartbeat liveness (``ray.nodes()`` analog),
+    served by the C++ GCS control plane that ``init()`` starts by default
+    (SURVEY.md §3.6: ray.init() always runs GCS on the head node)."""
+    return rt.get_runtime().nodes()
+
+
 def wait(refs: List[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None):
     ctx = rt.current_worker()
     if ctx is None:

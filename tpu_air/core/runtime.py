@@ -343,9 +343,92 @@ class Runtime:
         self._placement_thread.start()
         self._listener = threading.Thread(target=self._listen, daemon=True)
         self._listener.start()
+        # GCS control plane on the DEFAULT path: reference ray.init() always
+        # runs GCS on the head node (SURVEY.md §3.6, Install_locally.md:58-64),
+        # so single-host runs get the same membership / actor-directory /
+        # liveness machinery as multi-host instead of a dark control plane.
+        self.node_id = f"host-{os.environ.get('TPU_AIR_PROCESS_ID', '0')}"
+        self.gcs_address: Optional[str] = None
+        self._gcs_proc = None
+        self._gcs_heartbeat = None
+        self._gcs_client = None
+        self._gcs_lock = threading.Lock()
+        if os.environ.get("TPU_AIR_NO_GCS", "0") != "1":
+            self._start_gcs()
         self._min_idle = min(2, self.num_cpus)
         for _ in range(self._min_idle):
             self._spawn_worker()
+
+    # -- GCS control plane ---------------------------------------------------
+    def _start_gcs(self):
+        """Start (or join) the C++ control-plane daemon.  Best-effort: a
+        missing protobuf toolchain degrades to ``gcs_address=None`` and every
+        directory call becomes a no-op."""
+        existing = os.environ.get("TPU_AIR_GCS")
+        if existing:
+            # multi-host member / local-cluster child: join the cluster's
+            # daemon — membership/heartbeat already owned by the
+            # distributed layer (spawn_local_cluster / host agents)
+            self.gcs_address = existing
+            return
+        from tpu_air.control import client as _gcs_mod
+
+        if os.path.exists(os.path.join(_gcs_mod._NATIVE, "tpu_air_gcs")):
+            self._launch_gcs_daemon()  # binary ready: ~ms, synchronous
+        else:
+            # first use on a fresh checkout: build.sh (protoc + C++) can take
+            # minutes — init() must not block on it; the control plane comes
+            # up late and everything degrades gracefully until then
+            threading.Thread(
+                target=self._launch_gcs_daemon, daemon=True,
+                name="tpu_air-gcs-build",
+            ).start()
+
+    def _launch_gcs_daemon(self):
+        try:
+            from tpu_air.control import HeartbeatThread, start_gcs
+
+            proc, port = start_gcs(dead_after_ms=3000)
+            if self._stop.is_set():  # runtime shut down mid-build
+                proc.kill()
+                return
+            self._gcs_proc = proc
+            self.gcs_address = f"127.0.0.1:{port}"
+            self._gcs("register_node", self.node_id, address="",
+                      num_chips=self.num_chips)
+            self._gcs_heartbeat = HeartbeatThread(
+                self.gcs_address, self.node_id, interval=0.5,
+                num_chips=self.num_chips,
+            )
+            self._gcs_heartbeat.start()
+        except Exception as e:  # noqa: BLE001 — control plane is best-effort
+            print(f"tpu_air: gcs control plane unavailable: {e}", file=sys.stderr)
+            self.gcs_address = None
+
+    def _gcs(self, method: str, *args, **kwargs):
+        """Resilient GCS RPC: reconnect on failure (the daemon may restart),
+        never raise into the scheduler.  The client is shared across the
+        listener/placement/driver threads — create/teardown under a lock so
+        one thread can't close a socket another is about to use."""
+        if self.gcs_address is None:
+            return None
+        with self._gcs_lock:
+            try:
+                if self._gcs_client is None:
+                    from tpu_air.control import GcsClient
+
+                    self._gcs_client = GcsClient(self.gcs_address)
+                return getattr(self._gcs_client, method)(*args, **kwargs)
+            except (ConnectionError, OSError, RuntimeError):
+                if self._gcs_client is not None:
+                    self._gcs_client.close()
+                self._gcs_client = None
+                return None
+
+    def nodes(self) -> List[Dict]:
+        """Cluster membership with heartbeat liveness, from the control plane
+        (``ray.nodes()`` analog).  [] when the GCS is unavailable."""
+        return self._gcs("list_nodes") or []
 
     # -- worker management -------------------------------------------------
     def _pick_ctx(self):
@@ -476,13 +559,27 @@ class Runtime:
                         ),
                         task_id,
                     )
+            dead_actor = None
             if worker.actor_id and worker.actor_id in self.actors:
                 st = self.actors[worker.actor_id]
-                st.dead = True
-                self.free_chips.extend(st.chip_ids)
-                self.avail["chip"] += len(st.chip_ids)
-                st.chip_ids = []
+                # st.dead means kill_actor already released the claim — a
+                # killed worker's pipe-close lands here too, and releasing
+                # twice inflates avail until free_chips.pop underflows
+                if not st.dead:
+                    st.dead = True
+                    dead_actor = worker.actor_id
+                    if st.name:
+                        self.named_actors.pop(st.name, None)
+                    # release the FULL claim (cpu + chip), exactly like
+                    # kill_actor — chip avail comes back via st.resources,
+                    # the physical ids via free_chips
+                    self._release(st.resources)
+                    st.resources = {}
+                    self.free_chips.extend(st.chip_ids)
+                    st.chip_ids = []
             self.workers.pop(worker.worker_id, None)
+        if dead_actor:
+            self._gcs("mark_actor_dead", dead_actor)
         self._notify_objects()
         self._schedule()
 
@@ -694,13 +791,16 @@ class Runtime:
                     self._release(rec["resources"])
                     self.free_chips.extend(chip_ids)
                     self.pending_actors.pop(rec["actor_id"], None)
-                self.store.put(
-                    _ErrorSentinel(
-                        f"ActorPlacementFailed(actor={rec['actor_id']})",
-                        f"worker spawn failed: {type(e).__name__}: {e}",
-                    ),
-                    rec["ready_id"],
+                    buffered = self.pending_actor_tasks.pop(rec["actor_id"], [])
+                sentinel = _ErrorSentinel(
+                    f"ActorPlacementFailed(actor={rec['actor_id']})",
+                    f"worker spawn failed: {type(e).__name__}: {e}",
                 )
+                # resolve the ready ref AND every method call buffered while
+                # the actor was queued — a caller blocked (often without
+                # timeout) on a buffered call must not hang forever
+                for tid in [rec["ready_id"]] + [s.task_id for s in buffered]:
+                    self.store.put(sentinel, tid)
                 self._notify_objects()
                 continue
             with self.lock:
@@ -761,6 +861,10 @@ class Runtime:
                         )
                     )
                 self.pending_actors.pop(actor_id, None)
+            # publish to the GCS actor directory (outside the lock: localhost
+            # RPC, best-effort, must never stall the placement thread's lock)
+            self._gcs("register_actor", actor_id, node_id=self.node_id,
+                      name=rec["name"] or "", chip_ids=list(chip_ids))
 
     def submit_actor_task(self, actor_id, method, args, kwargs) -> ObjectRef:
         task_id = new_object_id()
@@ -827,17 +931,19 @@ class Runtime:
                 self._notify_objects()
                 return
             st = self.actors.get(actor_id)
-            if st is None:
+            if st is None or st.dead:  # already released (double-kill / crash)
                 return
             st.dead = True
             if st.name:
                 self.named_actors.pop(st.name, None)
             self._release(st.resources)
+            st.resources = {}
             self.free_chips.extend(st.chip_ids)
             st.chip_ids = []
             worker = st.worker
             worker.alive = False
             self.workers.pop(worker.worker_id, None)
+        self._gcs("mark_actor_dead", actor_id)
         try:
             worker.conn.send(("shutdown",))
         except OSError:
@@ -916,6 +1022,14 @@ class Runtime:
             w.proc.join(timeout=1)
             if w.proc.is_alive():
                 w.proc.terminate()
+        if self._gcs_heartbeat is not None:
+            self._gcs_heartbeat.stop()
+        if self._gcs_client is not None:
+            self._gcs_client.close()
+            self._gcs_client = None
+        if self._gcs_proc is not None:
+            self._gcs_proc.kill()
+            self._gcs_proc = None
         self.store.destroy()
 
 

@@ -54,6 +54,8 @@ def snapshot() -> Dict[str, Any]:
             "workers": workers,
             "actors": actors,
         }
+    # control-plane membership/liveness (outside the lock: GCS RPC)
+    out["gcs"] = {"address": rt.gcs_address, "nodes": rt.nodes()}
     return out
 
 

@@ -77,3 +77,25 @@ def allreduce(value: Any, *, name: str, rank: int, world_size: int,
             raise TimeoutError(f"allreduce {name}: rank {r} missing")
         vals.append(store.get(f"ar-{name}-{r}"))
     return reduce_fn(vals)
+
+
+def gather(value: Any, *, name: str, rank: int, world_size: int, dst: int = 0,
+           timeout: Optional[float] = 60.0) -> Optional[List[Any]]:
+    """Every rank contributes; rank ``dst`` returns the rank-ordered list,
+    all other ranks return None immediately.
+
+    Use instead of :func:`allreduce` when only one rank consumes the result
+    and the payloads are large (e.g. per-rank validation predictions): N
+    ranks each reading N arrays is O(N^2) store traffic, a gather is O(N)."""
+    store = Barrier(name, 0)._store()
+    store.put(value, f"g-{name}-{rank}")
+    if rank != dst:
+        return None
+    vals = []
+    deadline = None if timeout is None else time.monotonic() + timeout
+    for r in range(world_size):
+        remain = None if deadline is None else max(0.0, deadline - time.monotonic())
+        if not store.wait_for(f"g-{name}-{r}", timeout=remain):
+            raise TimeoutError(f"gather {name}: rank {r} missing")
+        vals.append(store.get(f"g-{name}-{r}"))
+    return vals

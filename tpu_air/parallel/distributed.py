@@ -408,6 +408,9 @@ class LocalCluster:
             self._gcs_client.close()
         if self.gcs_proc is not None:
             self.gcs_proc.kill()
+        # a later init() in this process must not try to join the dead daemon
+        if os.environ.get("TPU_AIR_GCS") == self.gcs_address:
+            os.environ.pop("TPU_AIR_GCS", None)
 
 
 def spawn_local_cluster(

@@ -12,7 +12,13 @@ routing round-robin across replicas (cc-79: "a managed group of Ray actors
 that ... handle requests load-balanced across them").
 """
 
-from .deployment import Application, Deployment, DeploymentHandle, deployment
+from .deployment import (
+    Application,
+    Deployment,
+    DeploymentHandle,
+    NoLiveReplicasError,
+    deployment,
+)
 from .http_adapters import json_request, pandas_read_json
 from .predictor_deployment import PredictorDeployment
 from .proxy import run, shutdown, status
@@ -21,6 +27,7 @@ __all__ = [
     "Application",
     "Deployment",
     "DeploymentHandle",
+    "NoLiveReplicasError",
     "PredictorDeployment",
     "deployment",
     "json_request",

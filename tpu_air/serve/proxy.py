@@ -15,7 +15,12 @@ from typing import Any, Dict, Optional
 
 from tpu_air.core import api as core_api
 
-from .deployment import Application, DeploymentHandle, start_replicas
+from .deployment import (
+    Application,
+    DeploymentHandle,
+    NoLiveReplicasError,
+    start_replicas,
+)
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -84,7 +89,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200, {p: h.deployment_name for p, h in _state.routes.items()})
             return
         if self.path.rstrip("/") == "/-/healthz":
-            self._respond(200, {"status": "ok"})
+            # per-deployment replica health: degraded (any route with zero
+            # live replicas) is a 503 so load balancers can act on it
+            detail = {
+                p: {"name": h.deployment_name, "live_replicas": h.num_replicas()}
+                for p, h in _state.routes.items()
+            }
+            healthy = all(d["live_replicas"] > 0 for d in detail.values())
+            self._respond(
+                200 if healthy else 503,
+                {"status": "ok" if healthy else "degraded", "deployments": detail},
+            )
             return
         handle = _state.match(self.path)
         if handle is None:
@@ -93,9 +108,12 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         try:
-            ref = handle.remote_http(body)
-            result = core_api.get(ref, timeout=300.0)
+            # failover path: replica death mid-request retries on a live
+            # replica; only application errors surface as 500
+            result = handle.call_http_sync(body, timeout=300.0)
             self._respond(200, _to_jsonable(result))
+        except NoLiveReplicasError as e:
+            self._respond(503, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — surface the error to the client
             self._respond(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -158,10 +176,15 @@ def run(
 
 
 def _retire(handle: DeploymentHandle) -> None:
-    """Kill a deployment's replica actors (releases processes + chip leases)."""
+    """Kill a deployment's replica actors (releases processes + chip leases)
+    and stop its restart controller so nothing respawns them."""
     from tpu_air.core.remote import kill
 
-    for replica in handle._replicas:
+    handle.stop()
+    with handle._lock:
+        replicas = list(handle._replicas)
+        handle._replicas = []
+    for replica in replicas:
         try:
             kill(replica)
         except Exception:

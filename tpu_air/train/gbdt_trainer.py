@@ -195,60 +195,73 @@ def _make_gbdt_worker_cls():
             self.model = cls(**sk, warm_start=True)
 
         def fit_round(self, i: int):
-            from tpu_air.parallel.collectives import allreduce
+            from tpu_air.parallel.collectives import allreduce, gather
 
             self.model.n_estimators = i
             self.model.fit(self.X, self.y)
             n = len(self.y)
+            rname = f"{self.run_name}-round-{i}"
+            # exchange the per-rank stage models so TRAIN metrics are
+            # computed against the same bagged ensemble the valid metrics
+            # (and the shipped checkpoint) use — local-model train metrics
+            # would shift with num_workers for identical params
+            models = allreduce(
+                self.model, name=f"{rname}-models", rank=self.rank,
+                world_size=self.world, reduce_fn=list, timeout=3600.0,
+            )
             if self.is_classif:
-                p = self.model.predict_proba(self.X)[:, 1]
-                local = {
+                p = np.mean([m.predict_proba(self.X)[:, 1] for m in models], axis=0)
+                sums = {
                     "n": float(n),
                     "ll_sum": _logloss(self.y, p) * n,
                     "err_sum": float(np.sum((p > 0.5) != self.y)),
-                    "valid_proba": (
-                        self.model.predict_proba(self.Xv)[:, 1]
-                        if self.Xv is not None else None
-                    ),
                 }
+                valid_local = (
+                    self.model.predict_proba(self.Xv)[:, 1]
+                    if self.Xv is not None else None
+                )
             else:
-                pred = self.model.predict(self.X)
-                local = {
+                pred = np.mean([m.predict(self.X) for m in models], axis=0)
+                sums = {
                     "n": float(n),
                     "se_sum": float(np.sum((pred - self.y) ** 2)),
-                    "valid_pred": (
-                        self.model.predict(self.Xv) if self.Xv is not None else None
-                    ),
                 }
+                valid_local = (
+                    self.model.predict(self.Xv) if self.Xv is not None else None
+                )
 
             def merge(vals):
-                out = {}
-                for k in vals[0]:
-                    if vals[0][k] is None:
-                        out[k] = None
-                    else:
-                        out[k] = np.sum([v[k] for v in vals], axis=0)
-                return out
+                return {k: np.sum([v[k] for v in vals], axis=0) for k in vals[0]}
 
+            # generous rendezvous deadline: one rank's fit on a big shard can
+            # take minutes, and a timeout here aborts training that the
+            # single-process path would complete
             merged = allreduce(
-                local, name=f"{self.run_name}-round-{i}", rank=self.rank,
-                world_size=self.world, reduce_fn=merge,
+                sums, name=rname, rank=self.rank, world_size=self.world,
+                reduce_fn=merge, timeout=3600.0,
+            )
+            # validation predictions are large and only rank 0 consumes them:
+            # gather (O(N) store reads) instead of allreduce (O(N^2))
+            vlist = gather(
+                valid_local, name=rname, rank=self.rank,
+                world_size=self.world, dst=0, timeout=3600.0,
             )
             if self.rank != 0:
                 return None
             # rank 0 turns merged sums into the reference's metric names
             metrics: Dict[str, Any] = {"iteration": i}
+            have_valid = vlist is not None and vlist[0] is not None
             if self.is_classif:
                 metrics["train-logloss"] = float(merged["ll_sum"] / merged["n"])
                 metrics["train-error"] = float(merged["err_sum"] / merged["n"])
-                if merged["valid_proba"] is not None:
-                    pv = merged["valid_proba"] / self.world  # bagged mean proba
+                if have_valid:
+                    pv = np.sum(vlist, axis=0) / self.world  # bagged mean proba
                     metrics["valid-error"] = float(np.mean((pv > 0.5) != self.yv))
                     metrics["valid-logloss"] = _logloss(self.yv, pv)
             else:
                 metrics["train-rmse"] = float(np.sqrt(merged["se_sum"] / merged["n"]))
-                if merged["valid_pred"] is not None:
-                    pv = merged["valid_pred"] / self.world
+                if have_valid:
+                    pv = np.sum(vlist, axis=0) / self.world
                     metrics["valid-rmse"] = float(np.sqrt(np.mean((pv - self.yv) ** 2)))
             return metrics
 
@@ -320,16 +333,24 @@ def _distributed_gbdt_loop(config, world, label_column, num_boost_round,
         # resolve, so its rendezvous keys (incl. per-round proba arrays) can
         # be deleted — otherwise they accumulate for the driver's lifetime
         for r in range(world):
-            try:
-                store.delete(f"ar-{run_name}-round-{i}-{r}")
-            except Exception:
-                pass
+            for key in (f"ar-{run_name}-round-{i}-{r}",
+                        f"ar-{run_name}-round-{i}-models-{r}",
+                        f"g-{run_name}-round-{i}-{r}"):
+                try:
+                    store.delete(key)
+                except Exception:
+                    pass
 
     try:
         for i in range(1, num_boost_round + 1):
-            outs = tpu_air.get([w.fit_round.remote(i) for w in workers])
+            try:
+                outs = tpu_air.get([w.fit_round.remote(i) for w in workers])
+            finally:
+                # also on the error path: a crashed rank must not strand the
+                # round's rendezvous payloads (incl. full validation-sized
+                # arrays) in the store for the driver's lifetime
+                cleanup_round(i)
             metrics = outs[0]
-            cleanup_round(i)
             stride = max(1, num_boost_round // 20)
             want_ckpt = (i % stride == 0) or (i == num_boost_round)
             session.report(metrics, checkpoint=ckpt(metrics, i) if want_ckpt else None)
