@@ -56,6 +56,10 @@ def pytest_configure(config):
         except Exception:
             pass
     env = dict(os.environ)
+    if env.get("PALLAS_AXON_POOL_IPS"):
+        # stash the tunnel address so TPU-gated tests (tests/test_tpu_hw.py)
+        # can hand it to their own subprocesses; the suite itself stays CPU
+        env.setdefault("TPU_AIR_REAL_TPU_IPS", env["PALLAS_AXON_POOL_IPS"])
     env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize gate for TPU plugin
     env.update(_want_env())
     env["TPU_AIR_TEST_REEXEC"] = "1"

@@ -136,3 +136,101 @@ def test_open_arena_missing_compiler_is_none(tmp_path, monkeypatch):
     ref = s.put({"a": 1})
     assert s.get(ref.id) == {"a": 1}
     s.destroy()
+
+
+# --------------------------------------------------------------------------
+# object spilling (VERDICT r2 item 8; Introduction…ipynb:cc-3 "object spilling")
+# --------------------------------------------------------------------------
+
+
+def _budgeted_store(tmp_path, monkeypatch, budget, arena_cap=1 << 16):
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    # tiny arena so multi-KB payloads take the file path, tiny file budget so
+    # the file path spills
+    Arena(os.path.join(root, "__arena__"), create=True,
+          capacity=arena_cap, slots=1 << 8)
+    monkeypatch.setenv("TPU_AIR_STORE_BYTES", str(budget))
+    monkeypatch.setenv("TPU_AIR_SPILL_DIR", str(tmp_path / "spill"))
+    return ObjectStore(root)
+
+
+def test_spill_on_budget_and_transparent_restore(tmp_path, monkeypatch):
+    s = _budgeted_store(tmp_path, monkeypatch, budget=300_000)
+    arrays = {}
+    refs = []
+    for i in range(8):  # 8 x ~100KB against a 300KB tmpfs budget
+        arr = np.full(100_000, i, dtype=np.uint8)
+        refs.append(s.put(arr))
+        arrays[refs[-1].id] = arr
+    spill = s.spill_stats()
+    assert spill["spilled_objects"] >= 4, spill
+    # root stays under budget (modulo the newest object)
+    root_bytes = sum(
+        os.path.getsize(os.path.join(s.root, n))
+        for n in os.listdir(s.root) if not n.startswith(("__", "."))
+    )
+    assert root_bytes <= 300_000 + 100_064
+    # every object — resident or spilled — restores transparently
+    for ref in refs:
+        np.testing.assert_array_equal(s.get(ref.id), arrays[ref.id])
+    # delete reaches spilled objects too
+    for ref in refs:
+        s.delete(ref.id)
+    assert s.spill_stats()["spilled_objects"] == 0
+    s.destroy()
+
+
+def test_spill_oldest_first_and_oversized_object(tmp_path, monkeypatch):
+    s = _budgeted_store(tmp_path, monkeypatch, budget=250_000)
+    first = s.put(np.zeros(100_000, dtype=np.uint8))
+    import time as _t
+    _t.sleep(0.05)  # mtime-ordered eviction needs distinct stamps
+    second = s.put(np.ones(100_000, dtype=np.uint8))
+    _t.sleep(0.05)
+    s.put(np.full(100_000, 2, dtype=np.uint8))  # pushes over budget
+    assert os.path.exists(s._spill_path(first.id)), "oldest object not spilled"
+    assert not os.path.exists(s._path(first.id))
+    assert os.path.exists(s._path(second.id)), "newer object wrongly evicted"
+    # an object larger than the whole budget goes straight to disk
+    huge = s.put(np.zeros(400_000, dtype=np.uint8))
+    assert os.path.exists(s._spill_path(huge.id))
+    assert s.get(huge.id).shape == (400_000,)
+    s.destroy()
+
+
+def test_dataset_larger_than_budget_spills_and_completes(tmp_path, monkeypatch):
+    """End-to-end: a map_batches pipeline whose blocks exceed the tmpfs
+    budget completes correctly, with spilled blocks restored on read."""
+    import subprocess
+    import sys
+
+    script = """
+import numpy as np
+import os
+import tpu_air
+from tpu_air.core import runtime as rt_mod
+
+tpu_air.init(num_cpus=2, num_chips=0)
+import tpu_air.data as data
+ds = data.from_items([{"x": np.zeros(100_000, dtype=np.uint8) + i} for i in range(12)])
+out = ds.map_batches(lambda df: df, batch_size=1).take_all()
+assert len(out) == 12
+sums = sorted(int(r["x"].sum()) for r in out)
+assert sums == sorted(i * 100_000 for i in range(12)), sums[:3]
+spill = rt_mod.get_runtime().store.spill_stats()
+assert spill["spilled_objects"] > 0, f"nothing spilled: {spill}"
+print("SPILL_E2E_OK", spill["spilled_objects"])
+tpu_air.shutdown()
+"""
+    env = dict(os.environ)
+    env["TPU_AIR_STORE_BYTES"] = "400000"
+    env["TPU_AIR_SPILL_DIR"] = str(tmp_path / "spill")
+    env["TPU_AIR_ARENA_BYTES"] = str(1 << 16)  # tiny arena: blocks hit files
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=180,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr[-2000:]}"
+    assert "SPILL_E2E_OK" in proc.stdout

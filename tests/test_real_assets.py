@@ -1,0 +1,137 @@
+"""Real-asset test tier (VERDICT r2 item 5): when a local cache holds the
+real ``google/flan-t5-small`` assets, exercise the REAL load paths — the
+from-scratch sentencepiece loader on the real ``spiece.model`` and the torch
+weight import into the Flax tree — instead of only tiny random fixtures.
+
+Without assets the tier SKIPS visibly (like test_tokenizer_spm.py's real-
+asset test); a real-path regression is then an explicit skip in the report,
+never a silent synthetic fallback.  Point the tier at assets with
+``TPU_AIR_ASSETS_DIR=<dir containing spiece.model [+ model weights]>`` or a
+populated HF hub cache.
+"""
+
+import glob
+import os
+
+import pytest
+
+pytestmark = pytest.mark.requires_assets
+
+
+def _find_flan_t5_small():
+    """Directory holding real flan-t5-small assets, or None."""
+    for env in ("TPU_AIR_ASSETS_DIR", "FLAN_T5_SMALL_DIR", "FLAN_T5_TOKENIZER_DIR"):
+        d = os.environ.get(env)
+        if d and os.path.exists(os.path.join(d, "spiece.model")):
+            return d
+    hf_home = os.environ.get(
+        "HF_HOME", os.path.expanduser("~/.cache/huggingface")
+    )
+    for snap in glob.glob(
+        os.path.join(hf_home, "hub", "models--google--flan-t5-small",
+                     "snapshots", "*")
+    ):
+        if os.path.exists(os.path.join(snap, "spiece.model")):
+            return snap
+    return None
+
+
+_ASSETS = _find_flan_t5_small()
+_skip = pytest.mark.skipif(
+    _ASSETS is None,
+    reason="real flan-t5-small assets not present "
+           "(set TPU_AIR_ASSETS_DIR or populate the HF cache)",
+)
+
+
+def _has_weights(d: str) -> bool:
+    return any(
+        os.path.exists(os.path.join(d, f))
+        for f in ("model.safetensors", "pytorch_model.bin")
+    )
+
+
+@_skip
+def test_real_spiece_loads_and_tokenizes():
+    """The from-scratch unigram loader reads the REAL 32k-piece vocab and
+    produces sane, reversible tokenizations."""
+    from tpu_air.models.sentencepiece_unigram import T5SentencePieceTokenizer
+
+    tok = T5SentencePieceTokenizer.from_pretrained(_ASSETS)
+    assert tok.vocab_size >= 32000, tok.vocab_size
+    ids = tok("Translate English to German: The house is wonderful.")["input_ids"]
+    assert len(ids) > 5 and ids[-1] == tok.eos_token_id
+    # no unk pieces for plain English, and the decode round-trips
+    text = tok.decode([i for i in ids if i != tok.eos_token_id])
+    assert "house" in text and "wonderful" in text
+
+
+@_skip
+def test_real_spiece_parity_with_hf():
+    """Tokenizer parity against the reference stack's own tokenizer on the
+    same asset, when transformers/sentencepiece can load it offline."""
+    from tpu_air.models.sentencepiece_unigram import T5SentencePieceTokenizer
+
+    try:
+        from transformers import T5Tokenizer
+
+        hf = T5Tokenizer.from_pretrained(_ASSETS, legacy=False)
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"HF tokenizer not loadable offline: {e}")
+    mine = T5SentencePieceTokenizer.from_pretrained(_ASSETS)
+    for s in [
+        "Translate English to German: hello world.",
+        "Give three tips for staying healthy.",
+        "The quick brown fox jumps over the lazy dog",
+    ]:
+        norm = " ".join(s.split())
+        assert mine(norm)["input_ids"] == hf(norm)["input_ids"], norm
+
+
+@_skip
+def test_real_weight_import_fingerprint():
+    """Import the real torch state dict into the Flax tree: structural
+    completeness (imported leaf set == fresh-init leaf set), finite values,
+    and a working jitted forward — the real W1 model path end-to-end."""
+    if not _has_weights(_ASSETS):
+        pytest.skip(f"no model weights next to spiece.model in {_ASSETS}")
+    torch = pytest.importorskip("torch")  # noqa: F841
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_air.models.t5 import T5ForConditionalGeneration
+    from tpu_air.models.t5.hf_import import load_t5_from_hf
+
+    model, params = load_t5_from_hf(_ASSETS, dtype="float32")
+    config = model.config
+
+    # structural fingerprint: every fresh-init leaf must be present with the
+    # same shape (a missed/renamed tensor in the converter shows up here)
+    ref = T5ForConditionalGeneration(config)
+    ref_params = ref.init(
+        jax.random.PRNGKey(0),
+        jnp.ones((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+        jnp.ones((1, 4), jnp.int32),
+    )["params"]
+    got = {jax.tree_util.keystr(k): v.shape
+           for k, v in jax.tree_util.tree_leaves_with_path(params)}
+    want = {jax.tree_util.keystr(k): v.shape
+            for k, v in jax.tree_util.tree_leaves_with_path(ref_params)}
+    assert got == want
+    n_params = sum(v.size for v in jax.tree_util.tree_leaves(params))
+    assert n_params > 70_000_000, n_params  # flan-t5-small is ~77M
+    assert all(
+        bool(jnp.isfinite(v).all()) for v in jax.tree_util.tree_leaves(params)
+    )
+
+    # behavioral fingerprint: the real weights drive a coherent forward
+    logits = jax.jit(
+        lambda p, i, m, d: model.apply({"params": p}, i, m, d)
+    )(
+        params,
+        jnp.array([[13959, 1566, 12, 2968, 10, 8774, 1]]),  # a real prompt
+        jnp.ones((1, 7), jnp.int32),
+        jnp.zeros((1, 1), jnp.int32),
+    )
+    assert logits.shape == (1, 1, config.vocab_size)
+    assert bool(jnp.isfinite(logits).all())

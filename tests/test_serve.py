@@ -157,17 +157,19 @@ def test_all_replicas_dead_gives_503(air):
     )
     assert _post("/solo", {})[0] == 200
     _kill_replica_process(h._replicas[0])
-    try:
-        status, out = _post("/solo", {})
-    except urllib.error.HTTPError as e:
-        status, out = e.code, json.loads(e.read())
-    assert status == 503, out
+    # healthz FIRST: liveness must be observable without routing a request
+    # through the dead replica (load balancers poll health, not traffic)
     try:
         status, health = _post("/-/healthz", {})
     except urllib.error.HTTPError as e:
         status, health = e.code, json.loads(e.read())
     assert status == 503 and health["status"] == "degraded"
     assert health["deployments"]["/solo"]["live_replicas"] == 0
+    try:
+        status, out = _post("/solo", {})
+    except urllib.error.HTTPError as e:
+        status, out = e.code, json.loads(e.read())
+    assert status == 503, out
 
 
 def test_application_errors_are_500_not_failover(air):

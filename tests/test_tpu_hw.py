@@ -1,0 +1,130 @@
+"""TPU-hardware-gated tests (VERDICT r2 item 2): the Pallas kernels must be
+proven COMPILED on the real chip, not just in interpret mode on CPU.
+
+The suite proper runs on XLA:CPU (conftest re-exec strips the TPU tunnel);
+these tests spawn their own subprocess with the tunnel restored.  They are
+marked ``tpu`` and excluded by default — run with::
+
+    python -m pytest tests/ -m tpu -q
+
+Skips visibly when no tunnel address is available.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+_TUNNEL = os.environ.get("TPU_AIR_REAL_TPU_IPS") or os.environ.get(
+    "PALLAS_AXON_POOL_IPS"
+)
+
+
+def _tpu_env() -> dict:
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = _TUNNEL
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("TPU_AIR_NUM_CHIPS", None)
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # PREPEND: the TPU plugin loads via a sitecustomize on the inherited
+    # PYTHONPATH — replacing the variable would silently drop to CPU
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    return env
+
+
+def _run_on_tpu(script: str, timeout: float = 900.0):
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=_tpu_env(),
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr[-3000:]}"
+    return proc.stdout
+
+
+_FLASH_SCRIPT = """
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == "tpu", jax.devices()
+from tpu_air.ops.flash_attention import flash_attention, _reference_attention
+
+B, H, L, D = 4, 12, 512, 64  # W1 attention shapes (flan-t5-base, seq 512)
+key = jax.random.PRNGKey(0)
+kq, kk, kv, kb, km = jax.random.split(key, 5)
+q = jax.random.normal(kq, (B * H, L, D), jnp.bfloat16)
+k = jax.random.normal(kk, (B * H, L, D), jnp.bfloat16)
+v = jax.random.normal(kv, (B * H, L, D), jnp.bfloat16)
+bias = jax.random.normal(kb, (H, L, L), jnp.float32)  # T5 per-head, batch-shared
+kv_mask = (jax.random.uniform(km, (B, L)) > 0.2).astype(jnp.int32)
+# repeat to (B*H, ...) grouping: kernel maps mask batch b -> grid b // (BH//B)
+
+for name, kwargs in [
+    ("bias+mask", dict(bias=bias, kv_mask=kv_mask, scale=1.0)),
+    ("plain", dict()),
+    ("causal", dict(causal=True)),
+]:
+    out = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, interpret=False, **kwargs)
+    )(q, k, v)
+    ref = _reference_attention(
+        q, k, v, kwargs.get("bias"), kwargs.get("scale", 1.0 / D ** 0.5),
+        kwargs.get("causal", False), kv_mask=(
+            (1.0 - kwargs["kv_mask"].astype(jnp.float32)) * -1e30
+            if "kv_mask" in kwargs else None
+        ),
+    )
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+    print(f"{name}: max_err={err:.5f}")
+    assert err < 0.06, f"{name}: compiled flash diverges from reference ({err})"
+print("FLASH_TPU_OK")
+"""
+
+
+def test_flash_attention_compiled_on_chip():
+    """Flash forward COMPILED on TPU (not interpret) matches the dense
+    reference at W1 shapes, for the T5 bias+mask, plain, and causal paths."""
+    if not _TUNNEL:
+        pytest.skip("no TPU tunnel address (PALLAS_AXON_POOL_IPS unset)")
+    out = _run_on_tpu(_FLASH_SCRIPT)
+    assert "FLASH_TPU_OK" in out
+
+
+_RING_SCRIPT = """
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == "tpu", jax.devices()
+from jax.sharding import Mesh
+from tpu_air.ops.ring_attention import ring_attention_sharded
+from tpu_air.ops.flash_attention import _reference_attention
+
+# single-chip mesh: the ring degenerates to one hop but the COMPILED
+# shard_map + pallas path executes on hardware
+mesh = Mesh(jax.devices()[:1], ("sequence",))
+BH, L, D = 8, 1024, 64
+key = jax.random.PRNGKey(1)
+kq, kk, kv = jax.random.split(key, 3)
+q = jax.random.normal(kq, (BH, L, D), jnp.bfloat16)
+k = jax.random.normal(kk, (BH, L, D), jnp.bfloat16)
+v = jax.random.normal(kv, (BH, L, D), jnp.bfloat16)
+out = ring_attention_sharded(q, k, v, mesh, causal=True)
+ref = _reference_attention(q, k, v, None, 1.0 / D ** 0.5, True)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+print(f"ring: max_err={err:.5f}")
+assert err < 0.06, err
+print("RING_TPU_OK")
+"""
+
+
+def test_ring_attention_step_on_chip():
+    """One compiled ring-attention step executes on the real chip."""
+    if not _TUNNEL:
+        pytest.skip("no TPU tunnel address (PALLAS_AXON_POOL_IPS unset)")
+    out = _run_on_tpu(_RING_SCRIPT)
+    assert "RING_TPU_OK" in out

@@ -81,10 +81,100 @@ class ObjectStore:
 
         self._arena = shm_arena.open_arena(root, create)
         self._arena_retry_at = 0.0
+        # Spilling (reference: "efficient memory usage, object spilling",
+        # Introduction_to_Ray_AI_Runtime.ipynb:cc-3): the store root lives in
+        # tmpfs (RAM); when file objects exceed TPU_AIR_STORE_BYTES, sealed
+        # objects move to a DISK directory and restore transparently on get.
+        # 0 (default) = unlimited, no scanning overhead on the hot path.
+        self._file_budget = int(os.environ.get("TPU_AIR_STORE_BYTES", "0") or 0)
+        # deterministic from root so every process of the session agrees; a
+        # user-configured dir gets a per-session subdir so destroy() can
+        # never wipe a concurrent session's spilled objects
+        session_tag = os.path.basename(root.rstrip(os.sep))
+        custom = os.environ.get("TPU_AIR_SPILL_DIR")
+        self._spill_dir = (
+            os.path.join(custom, session_tag) if custom
+            else os.path.join("/var/tmp", f"tpu_air-spill-{session_tag}")
+        )
 
     # -- paths ------------------------------------------------------------
     def _path(self, object_id: str) -> str:
         return os.path.join(self.root, object_id)
+
+    def _spill_path(self, object_id: str) -> str:
+        return os.path.join(self._spill_dir, object_id)
+
+    # -- spilling ----------------------------------------------------------
+    def _scan_files(self):
+        """(mtime, size, name) for sealed file objects under the root."""
+        out = []
+        try:
+            with os.scandir(self.root) as it:
+                for e in it:
+                    if e.name.startswith((".", "__")):
+                        continue  # tmp files / __arena__
+                    try:
+                        st = e.stat()
+                    except OSError:
+                        continue
+                    out.append((st.st_mtime, st.st_size, e.name))
+        except OSError:
+            pass
+        return out
+
+    def _spill_object(self, name: str) -> bool:
+        """Move one sealed object root → spill dir (copy, seal, unlink).
+        Concurrent readers stay safe: an already-open mmap survives the
+        unlink, and get() falls back to the spill path on FileNotFound."""
+        src, dst = self._path(name), self._spill_path(name)
+        tmp = os.path.join(self._spill_dir, f".tmp-{name}-{os.getpid()}")
+        try:
+            import shutil
+
+            shutil.copyfile(src, tmp)
+            os.chmod(tmp, 0o444)
+            os.rename(tmp, dst)  # atomic seal in the spill dir
+            os.chmod(src, 0o644)
+            os.remove(src)
+            return True
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+
+    def _make_room(self, need: int) -> bool:
+        """Spill oldest sealed objects until ``need`` bytes fit under the
+        budget.  True when the new object can be written to the root."""
+        files = self._scan_files()
+        usage = sum(s for _, s, _ in files)
+        if usage + need <= self._file_budget:
+            return True
+        os.makedirs(self._spill_dir, exist_ok=True)
+        for _, size, name in sorted(files):
+            if usage + need <= self._file_budget:
+                break
+            if self._spill_object(name):
+                usage -= size
+        return usage + need <= self._file_budget
+
+    def spill_stats(self) -> dict:
+        objs, total = 0, 0
+        try:
+            with os.scandir(self._spill_dir) as it:
+                for e in it:
+                    if e.name.startswith("."):
+                        continue
+                    try:
+                        total += e.stat().st_size
+                        objs += 1
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return {"spill_dir": self._spill_dir, "spilled_objects": objs,
+                "spilled_bytes": total, "budget_bytes": self._file_budget}
 
     # -- write ------------------------------------------------------------
     def put(self, value: Any, object_id: Optional[str] = None) -> ObjectRef:
@@ -95,12 +185,22 @@ class ObjectStore:
     def put_serialized(self, chunks, object_id: str) -> None:
         if self._arena is not None and self._arena.put_chunks(object_id, chunks):
             return
-        tmp = self._path(f".tmp-{object_id}-{os.getpid()}")
+        target_root = self.root
+        if self._file_budget:
+            need = sum(
+                c.nbytes if isinstance(c, memoryview) else len(c) for c in chunks
+            )
+            if not self._make_room(need):
+                # even after spilling everything the new object busts the
+                # tmpfs budget — write it straight to disk
+                os.makedirs(self._spill_dir, exist_ok=True)
+                target_root = self._spill_dir
+        tmp = os.path.join(target_root, f".tmp-{object_id}-{os.getpid()}")
         with open(tmp, "wb") as f:
             for c in chunks:
                 f.write(c)
         os.chmod(tmp, 0o444)  # immutability contract
-        os.rename(tmp, self._path(object_id))
+        os.rename(tmp, os.path.join(target_root, object_id))
 
     def _maybe_reopen_arena(self) -> None:
         """Heal a failed arena open.  Writers put arena-resident objects with
@@ -123,7 +223,9 @@ class ObjectStore:
         self._maybe_reopen_arena()
         if self._arena is not None and self._arena.contains(object_id):
             return True
-        return os.path.exists(self._path(object_id))
+        if os.path.exists(self._path(object_id)):
+            return True
+        return bool(self._file_budget) and os.path.exists(self._spill_path(object_id))
 
     def wait_for(self, object_id: str, timeout: Optional[float] = None) -> bool:
         """Block until the object is sealed. Returns False on timeout."""
@@ -145,11 +247,25 @@ class ObjectStore:
                 # zero-copy: buffers reference the arena mapping; space is
                 # never reused (delete only tombstones), so views stay valid
                 return serialization.deserialize(view, zero_copy=True)
-        path = self._path(object_id)
-        size = os.path.getsize(path)
+        # root first, spill-dir fallback; a concurrent _make_room may move
+        # the object between ANY two syscalls here, so both the stat and the
+        # open must tolerate disappearance and retry the other location
+        fd = size = None
+        for _ in range(3):
+            for path in (self._path(object_id), self._spill_path(object_id)):
+                try:
+                    fd = os.open(path, os.O_RDONLY)
+                    size = os.fstat(fd).st_size
+                    break
+                except FileNotFoundError:
+                    continue
+            if fd is not None:
+                break
+        if fd is None:
+            raise TimeoutError(f"object {object_id} vanished mid-read")
         if size == 0:
+            os.close(fd)
             return serialization.loads(serialization.dumps(None))
-        fd = os.open(path, os.O_RDONLY)
         try:
             m = mmap.mmap(fd, size, prot=mmap.PROT_READ)
         finally:
@@ -163,23 +279,25 @@ class ObjectStore:
     def delete(self, object_id: str) -> None:
         if self._arena is not None:
             self._arena.delete(object_id)
-        try:
-            os.chmod(self._path(object_id), 0o644)
-            os.remove(self._path(object_id))
-        except FileNotFoundError:
-            pass
+        for path in (self._path(object_id), self._spill_path(object_id)):
+            try:
+                os.chmod(path, 0o644)
+                os.remove(path)
+            except OSError:
+                pass
 
     def destroy(self) -> None:
         if self._arena is not None:
             self._arena.close()
             self._arena = None
-        try:
-            for name in os.listdir(self.root):
-                try:
-                    os.chmod(os.path.join(self.root, name), 0o644)
-                    os.remove(os.path.join(self.root, name))
-                except OSError:
-                    pass
-            os.rmdir(self.root)
-        except OSError:
-            pass
+        for d in (self.root, self._spill_dir):
+            try:
+                for name in os.listdir(d):
+                    try:
+                        os.chmod(os.path.join(d, name), 0o644)
+                        os.remove(os.path.join(d, name))
+                    except OSError:
+                        pass
+                os.rmdir(d)
+            except OSError:
+                pass

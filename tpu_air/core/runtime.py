@@ -260,19 +260,40 @@ def _worker_main(
 _STALE_SESSION_AGE_S = 2 * 3600.0
 
 
+def _kill_quietly(proc) -> None:
+    try:
+        proc.kill()
+    except (OSError, ProcessLookupError):
+        pass
+
+
 def _sweep_stale_sessions(base: str) -> None:
     """Remove store dirs leaked by killed sessions (tmpfs is RAM — leaks
     accumulate).  A dir is stale when untouched for _STALE_SESSION_AGE_S."""
     now = time.time()
-    try:
-        names = os.listdir(base)
-    except OSError:
-        return
-    for name in names:
-        if not name.startswith("tpu_air-"):
-            continue
-        path = os.path.join(base, name)
+    names = []
+    for d in (base, "/var/tmp"):  # /var/tmp: spill dirs of killed sessions
         try:
+            names += [(d, n) for n in os.listdir(d)]
+        except OSError:
+            pass
+    for d, name in names:
+        if not name.startswith(("tpu_air-", "tpu_air-spill-")):
+            continue
+        if d == "/var/tmp" and not name.startswith("tpu_air-spill-"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            if name.startswith("tpu_air-spill-"):
+                # a spill dir's mtime goes stale while its session still
+                # runs (spills may all happen early) — it is reapable only
+                # once the owning store root is gone from every base
+                owner = name[len("tpu_air-spill-"):]
+                if any(
+                    os.path.exists(os.path.join(b, owner))
+                    for b in ("/dev/shm", tempfile.gettempdir())
+                ):
+                    continue
             if now - os.path.getmtime(path) < _STALE_SESSION_AGE_S:
                 continue
             for f in os.listdir(path):
@@ -386,6 +407,8 @@ class Runtime:
 
     def _launch_gcs_daemon(self):
         try:
+            import atexit
+
             from tpu_air.control import HeartbeatThread, start_gcs
 
             proc, port = start_gcs(dead_after_ms=3000)
@@ -393,6 +416,10 @@ class Runtime:
                 proc.kill()
                 return
             self._gcs_proc = proc
+            # the daemon must not outlive this process even when an
+            # exception skips shutdown(): an orphan daemon holds the
+            # inherited stderr pipe open, wedging any parent reading it
+            atexit.register(_kill_quietly, proc)
             self.gcs_address = f"127.0.0.1:{port}"
             self._gcs("register_node", self.node_id, address="",
                       num_chips=self.num_chips)

@@ -32,8 +32,9 @@ class LMConfig:
     tie_embeddings: bool = True
     attention: str = "dense"          # dense | flash | ring
     sequence_axis: Optional[str] = None  # mesh axis for ring attention
-    block_q: int = 128
-    block_k: int = 128
+    # None -> kernel's measured-on-TPU auto tiling (512/1024 caps)
+    block_q: Optional[int] = None
+    block_k: Optional[int] = None
     pad_token_id: int = 0
 
     def __post_init__(self):

@@ -173,8 +173,8 @@ class Attention(nn.Module):
 
             # position_bias stays (1, H, q, k) — the kernel's BlockSpec
             # replays the head tile per batch element; no HBM broadcast.
-            block = next(s for s in (128, 64, 32, 16, 8, 4, 2, 1) if qlen % s == 0)
-            kblock = next(s for s in (128, 64, 32, 16, 8, 4, 2, 1) if klen % s == 0)
+            # Block sizes: None → the kernel's measured-on-TPU auto tiling
+            # (512/1024 caps; 128-capped tiles ran the MXU at ~1/8 rate).
             ctx = flash_attention(
                 q.transpose(0, 2, 1, 3),
                 k.transpose(0, 2, 1, 3),
@@ -183,8 +183,6 @@ class Attention(nn.Module):
                 kv_mask=kv_mask,
                 causal=causal,
                 scale=1.0,  # T5: unscaled scores
-                block_q=block,
-                block_k=kblock,
             ).transpose(0, 2, 1, 3)
         else:
             if mask is None and (kv_mask is not None or causal):

@@ -118,23 +118,34 @@ class ByteTokenizer:
         return cls()
 
 
-def auto_tokenizer(name_or_path: str):
+def auto_tokenizer(name_or_path: str, strict: bool = False):
     """Best-effort tokenizer resolution (predictor.py:64 defaults to
     AutoTokenizer): HF fast tokenizer when its assets resolve locally, else
     the framework's pure-Python sentencepiece unigram loader for on-disk
     ``spiece.model``/``tokenizer.json`` (real FLAN-T5 vocab, offline), else
-    ByteTokenizer."""
+    ByteTokenizer.
+
+    ``strict=True`` disables the ByteTokenizer fallback: a missing real
+    vocab raises with both loaders' errors instead of silently degrading
+    (a degraded tokenizer makes every downstream result quietly wrong)."""
+    errors = []
     try:
         from transformers import AutoTokenizer
 
         return AutoTokenizer.from_pretrained(name_or_path)
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"transformers.AutoTokenizer: {type(e).__name__}: {e}")
     try:
         from .sentencepiece_unigram import T5SentencePieceTokenizer
 
         return T5SentencePieceTokenizer.from_pretrained(name_or_path)
-    except Exception:
-        if os.path.isdir(name_or_path):
-            return ByteTokenizer.from_pretrained(name_or_path)
-        return ByteTokenizer()
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"T5SentencePieceTokenizer: {type(e).__name__}: {e}")
+    if strict:
+        raise RuntimeError(
+            f"auto_tokenizer({name_or_path!r}, strict=True): no real vocab "
+            "loadable:\n  " + "\n  ".join(errors)
+        )
+    if os.path.isdir(name_or_path):
+        return ByteTokenizer.from_pretrained(name_or_path)
+    return ByteTokenizer()

@@ -84,6 +84,7 @@ def object_stats() -> Dict[str, Any]:
     }
     if rt.store._arena is not None:
         out["arena"] = rt.store._arena.stats()
+    out["spill"] = rt.store.spill_stats()
     return out
 
 

@@ -119,11 +119,28 @@ def _bias_index_map(bias_b: int, bh: int):
     raise ValueError(f"bias leading dim {bias_b} incompatible with batch·heads {bh}")
 
 
+_BLOCK_CANDIDATES = (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+# Measured on TPU v5e (BH=48, D=64, bf16, slope-timed): (128, 128) runs at
+# 6-8 TF/s while (512, 1024) reaches 48-80 TF/s — 3-5x FASTER than XLA's
+# dense path at L >= 2048 and ~parity at L = 512.  Bigger k tiles amortize
+# the per-block online-softmax rescale; bigger q tiles amortize k/v streams.
+_AUTO_BLOCK_Q_CAP = 512
+_AUTO_BLOCK_K_CAP = 1024
+
+
+def _auto_block(length: int, cap: int) -> int:
+    """Largest power-of-two-ish tile <= cap that divides ``length``."""
+    for s in _BLOCK_CANDIDATES:
+        if s <= cap and s <= length and length % s == 0:
+            return s
+    return 1
+
+
 def _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k, interpret):
     bh, lq, d = q.shape
     lk = k.shape[1]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
+    block_q = _auto_block(lq, _AUTO_BLOCK_Q_CAP) if block_q is None else min(block_q, lq)
+    block_k = _auto_block(lk, _AUTO_BLOCK_K_CAP) if block_k is None else min(block_k, lk)
     if lq % block_q or lk % block_k:
         raise ValueError(
             f"sequence lengths ({lq}, {lk}) must divide block sizes "
@@ -303,8 +320,8 @@ def flash_attention(
     kv_mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Blockwise attention.
@@ -334,7 +351,8 @@ def flash_attention(
 
 def flash_attention_with_lse(
     q, k, v, bias=None, *, kv_mask=None, scale=None, causal=False,
-    block_q: int = 128, block_k: int = 128, interpret: Optional[bool] = None,
+    block_q: Optional[int] = None, block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """(out, logsumexp) variant — ring attention merges partial softmaxes
     across devices with the lse.  Differentiable in both outputs."""

@@ -49,8 +49,8 @@ def ring_attention(
     axis_name: str,
     scale: Optional[float] = None,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ):
     """Attention over sequence-sharded q/k/v inside shard_map/pmap.
 
@@ -100,7 +100,7 @@ def ring_attention(
 
 def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sequence",
                            causal: bool = False, scale=None,
-                           block_q: int = 128, block_k: int = 128):
+                           block_q: Optional[int] = None, block_k: Optional[int] = None):
     """Convenience wrapper: shard (bh, L, d) arrays over ``axis_name`` of
     ``mesh`` and run ring attention via shard_map."""
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -203,7 +203,11 @@ class DeploymentHandle:
             self._replicas = [r for r in self._replicas if r is not replica]
 
     def num_replicas(self) -> int:
+        """Count of LIVE replicas.  Prunes dead ones on read so health
+        reporting is accurate even with the restart controller disabled
+        (max_restarts=0) and no traffic since a replica died."""
         with self._lock:
+            self._replicas = [r for r in self._replicas if not _actor_dead(r)]
             return len(self._replicas)
 
     # -- calls ---------------------------------------------------------------
