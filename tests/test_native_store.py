@@ -234,3 +234,100 @@ tpu_air.shutdown()
     )
     assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr[-2000:]}"
     assert "SPILL_E2E_OK" in proc.stdout
+
+
+# --------------------------------------------------------------------------
+# native ownership / ref-counting / block reuse (SURVEY.md §2B core_worker
+# row: "ownership/ref-counting in native code"; plasma reclamation contract)
+# --------------------------------------------------------------------------
+
+
+def test_delete_reclaims_space_for_reuse(tmp_path):
+    """An unpinned delete returns the block to the shared free list and a
+    later alloc reuses it — the arena no longer only-grows."""
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    Arena(os.path.join(root, "__arena__"), create=True,
+          capacity=1 << 20, slots=1 << 10)
+    s = ObjectStore(root)
+    payload = np.zeros(200_000, dtype=np.uint8)
+    # churn 50 x 200KB through a 1MB arena: without reuse this needs 10MB
+    for i in range(50):
+        ref = s.put(payload + (i % 251))
+        assert s._arena.contains(ref.id), f"round {i} fell back to file"
+        val = s.get(ref.id)
+        assert val[0] == i % 251
+        del val
+        import gc
+        gc.collect()  # drop the value's pin before deleting
+        s.delete(ref.id)
+    st = s._arena.stats()
+    assert st["used"] <= (1 << 20), st
+    assert not [n for n in os.listdir(root) if not n.startswith("__")]
+    s.destroy()
+
+
+def test_pinned_object_survives_delete_until_value_dies(tmp_path):
+    """Ray/plasma ownership: delete while a zero-copy reader holds the value
+    parks the object (ZOMBIE); bytes stay valid; the last reference's death
+    releases the pin and reclaims the block."""
+    import gc
+
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    Arena(os.path.join(root, "__arena__"), create=True,
+          capacity=1 << 20, slots=1 << 10)
+    s = ObjectStore(root)
+    arr = np.arange(50_000, dtype=np.uint32)
+    ref = s.put(arr)
+    val = s.get(ref.id)  # zero-copy view, pinned
+    assert s._arena.pins(ref.id) == 1
+    s.delete(ref.id)
+    assert not s.contains(ref.id)  # invisible immediately
+    # hammer the arena with new objects that would love the freed block
+    for i in range(20):
+        s.put(np.full(60_000, i, dtype=np.uint8))
+    np.testing.assert_array_equal(val, arr)  # bytes never reused while pinned
+    free_before = s._arena.stats()["free_bytes"]
+    del val
+    gc.collect()
+    free_after = s._arena.stats()["free_bytes"]
+    assert free_after > free_before, "last unpin did not reclaim the zombie"
+    s.destroy()
+
+
+def test_self_contained_values_release_pin_immediately(tmp_path):
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    Arena(os.path.join(root, "__arena__"), create=True,
+          capacity=1 << 20, slots=1 << 10)
+    s = ObjectStore(root)
+    ref = s.put({"k": "v", "n": 17})  # no out-of-band buffers
+    v = s.get(ref.id)
+    assert v == {"k": "v", "n": 17}
+    assert s._arena.pins(ref.id) == 0, "nbuf==0 value must not hold a pin"
+    s.destroy()
+
+
+def test_reput_same_id_while_old_generation_zombie(tmp_path):
+    """Pin disambiguation: unpinning an old generation must not touch a
+    re-put of the same id."""
+    import gc
+
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    Arena(os.path.join(root, "__arena__"), create=True,
+          capacity=1 << 20, slots=1 << 10)
+    s = ObjectStore(root)
+    oid = new_object_id()
+    s.put(np.zeros(10_000, dtype=np.uint8), oid)
+    old = s.get(oid)          # pin generation 1
+    s.delete(oid)             # gen 1 → zombie
+    s.put(np.ones(10_000, dtype=np.uint8), oid)  # gen 2, same id
+    new = s.get(oid)
+    assert new[0] == 1 and old[0] == 0
+    del old
+    gc.collect()              # unpin gen 1 → reclaimed
+    assert s._arena.pins(oid) == 1, "gen-2 pin must survive gen-1 unpin"
+    np.testing.assert_array_equal(new, np.ones(10_000, dtype=np.uint8))
+    s.destroy()

@@ -134,9 +134,9 @@ def test_replica_crash_failover_and_restart(air):
         assert status == 200 and "pid" in out
     # the restart controller brings the group back to size
     deadline = time.time() + 30
-    while time.time() < deadline and h.num_replicas() < 2:
+    while time.time() < deadline and h.live_replicas() < 2:
         time.sleep(0.2)
-    assert h.num_replicas() == 2, "dead replica was not respawned"
+    assert h.live_replicas() == 2, "dead replica was not respawned"
     pids = {_post("/who2", {})[1]["pid"] for _ in range(8)}
     assert len(pids) == 2  # both (incl. the new) replicas serve
 

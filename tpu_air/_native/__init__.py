@@ -49,7 +49,14 @@ def load_store_lib() -> ctypes.CDLL:
     lib.arena_lookup.restype = ctypes.c_int
     lib.arena_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.arena_delete.restype = ctypes.c_int
-    for fn in ("arena_capacity", "arena_used", "arena_live_objects", "arena_sealed_bytes"):
+    lib.arena_lookup_pin.argtypes = lib.arena_lookup.argtypes
+    lib.arena_lookup_pin.restype = ctypes.c_int
+    lib.arena_unpin.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64]
+    lib.arena_unpin.restype = ctypes.c_int
+    lib.arena_pins.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.arena_pins.restype = ctypes.c_int64
+    for fn in ("arena_capacity", "arena_used", "arena_live_objects",
+               "arena_sealed_bytes", "arena_free_bytes", "arena_leaked_bytes"):
         f = getattr(lib, fn)
         f.argtypes = [ctypes.c_int]
         f.restype = ctypes.c_uint64

@@ -38,8 +38,11 @@ int arena_close(int h);
 int64_t arena_alloc(int h, const uint8_t* id, uint64_t size);
 int arena_seal(int h, const uint8_t* id);
 int arena_lookup(int h, const uint8_t* id, uint64_t* offset, uint64_t* size);
+int arena_lookup_pin(int h, const uint8_t* id, uint64_t* offset, uint64_t* size);
+int arena_unpin(int h, const uint8_t* id, uint64_t offset);
 int arena_delete(int h, const uint8_t* id);
 uint64_t arena_live_objects(int h);
+uint64_t arena_free_bytes(int h);
 }
 
 namespace {
@@ -136,6 +139,47 @@ int reader_loop(int h, const uint8_t* base, int nwriters, int nobjs) {
   return 0;
 }
 
+// Ownership churn: two threads share an id space and race
+// alloc/seal/pin/delete/unpin.  The invariant under test (TSan target): a
+// PINNED object's bytes never change — even after arena_delete parks it in
+// ZOMBIE and other threads' allocations are hungry for reusable blocks.
+int churn_loop(int h, uint8_t* base, int pair, int iters, int nobjs) {
+  uint8_t id[kIdBytes];
+  for (int it = 0; it < iters; ++it) {
+    int o = it % nobjs;
+    std::memset(id, 0, kIdBytes);
+    std::snprintf(reinterpret_cast<char*>(id), kIdBytes, "c%08d_o%08d", pair, o);
+    int64_t aoff = arena_alloc(h, id, kObjSize);
+    if (aoff >= 0) {
+      for (uint64_t i = 0; i < kObjSize; ++i)
+        base[(uint64_t)aoff + i] = pattern_byte(1000 + pair, o, i);
+      arena_seal(h, id);  // may lose to a concurrent delete; fine
+    } else if (aoff != -3) {
+      std::fprintf(stderr, "churn %d: alloc failed %lld (reuse broken?)\n",
+                   pair, (long long)aoff);
+      return 1;
+    }
+    uint64_t off = 0, size = 0;
+    if (arena_lookup_pin(h, id, &off, &size) == 1) {
+      for (int round = 0; round < 2; ++round) {
+        for (uint64_t i = 0; i < kObjSize; i += 61) {
+          if (base[off + i] != pattern_byte(1000 + pair, o, i)) {
+            std::fprintf(stderr,
+                         "churn %d: pinned bytes changed (o=%d round=%d) — "
+                         "reclamation ignored the pin\n",
+                         pair, o, round);
+            return 1;
+          }
+        }
+        // first round verifies sealed; delete, then verify the ZOMBIE
+        if (round == 0) arena_delete(h, id);
+      }
+      arena_unpin(h, id, off);
+    }
+  }
+  return 0;
+}
+
 int run_threads(const char* path, int nwriters, int nreaders, int nobjs) {
   int h = arena_open(path);
   if (h < 0) return 2;
@@ -149,6 +193,12 @@ int run_threads(const char* path, int nwriters, int nreaders, int nobjs) {
     ts.emplace_back([&, w] { failures += writer_loop(h, base, w, nobjs); });
   for (int r = 0; r < nreaders; ++r)
     ts.emplace_back([&] { failures += reader_loop(h, base, nwriters, nobjs); });
+  // ownership churn pairs: 2 threads per shared id space racing
+  // alloc/pin/delete/unpin against the reclamation machinery
+  for (int p = 0; p < nwriters; ++p)
+    for (int t = 0; t < 2; ++t)
+      ts.emplace_back(
+          [&, p] { failures += churn_loop(h, base, p, 4 * nobjs, nobjs); });
   for (auto& t : ts) t.join();
 
   uint64_t live = arena_live_objects(h);

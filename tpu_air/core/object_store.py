@@ -11,7 +11,11 @@ reconstruct numpy/Arrow payloads zero-copy over the mapping.
 
 A C++ arena-based store (``tpu_air/_native``) provides an accelerated backend
 with the same wire format when built; this module is the always-available
-fallback and the reference semantics.
+fallback and the reference semantics.  The arena owns object lifecycle in
+native code (SURVEY.md §2B core_worker row): zero-copy reads hold a
+cross-process PIN refcount, ``delete`` parks pinned objects in a zombie
+state, and the last unpin reclaims the block into a shared free list for
+reuse — the plasma ownership contract.
 
 Cross-host fetch (DCN) goes through the control plane in ``runtime.py`` —
 single-host deployments (everything the reference exercises locally) never hit
@@ -147,6 +151,11 @@ class ObjectStore:
     def _make_room(self, need: int) -> bool:
         """Spill oldest sealed objects until ``need`` bytes fit under the
         budget.  True when the new object can be written to the root."""
+        if need > self._file_budget:
+            # spilling residents can't help — don't evict the hot set for an
+            # object that is going to disk regardless
+            os.makedirs(self._spill_dir, exist_ok=True)
+            return False
         files = self._scan_files()
         usage = sum(s for _, s, _ in files)
         if usage + need <= self._file_budget:
@@ -242,11 +251,9 @@ class ObjectStore:
         if not self.wait_for(object_id, timeout):
             raise TimeoutError(f"object {object_id} not available after {timeout}s")
         if self._arena is not None:
-            view = self._arena.lookup(object_id)
-            if view is not None:
-                # zero-copy: buffers reference the arena mapping; space is
-                # never reused (delete only tombstones), so views stay valid
-                return serialization.deserialize(view, zero_copy=True)
+            pinned = self._arena.lookup_pin(object_id)
+            if pinned is not None:
+                return self._get_pinned(object_id, *pinned)
         # root first, spill-dir fallback; a concurrent _make_room may move
         # the object between ANY two syscalls here, so both the stat and the
         # open must tolerate disappearance and retry the other location
@@ -276,14 +283,47 @@ class ObjectStore:
         # valid exactly as long as the value references it.
         return serialization.deserialize(m, zero_copy=True)
 
+    def _get_pinned(self, object_id: str, view, offset: int) -> Any:
+        """Deserialize an arena object under a read pin (native ownership:
+        the C++ arena won't reclaim the bytes while the pin is held).
+
+        * value holds NO views into the arena (nbuf == 0): unpin now.
+        * value holds views and is weakref-able (arrays, DataFrames, model
+          objects — every large zero-copy case): the pin is released by a
+          finalizer when the value dies, so ``delete`` + block reuse can
+          never invalidate memory the value still references.
+        * value holds views but can't carry a finalizer (plain dict/list
+          containers): re-deserialize as copies, then unpin — correctness
+          over zero-copy for that minority shape.
+        """
+        import weakref
+
+        try:
+            value, nbuf = serialization.deserialize_ex(view, zero_copy=True)
+        except BaseException:
+            self._arena.unpin(object_id, offset)
+            raise
+        if nbuf == 0:
+            self._arena.unpin(object_id, offset)
+            return value
+        try:
+            weakref.finalize(value, self._arena.unpin, object_id, offset)
+        except TypeError:
+            value = serialization.deserialize(view, zero_copy=False)
+            self._arena.unpin(object_id, offset)
+        return value
+
     def delete(self, object_id: str) -> None:
         if self._arena is not None:
             self._arena.delete(object_id)
         for path in (self._path(object_id), self._spill_path(object_id)):
-            try:
+            try:  # chmod best-effort: files are sealed 0o444
                 os.chmod(path, 0o644)
-                os.remove(path)
             except OSError:
+                pass
+            try:  # remove regardless — a chmod failure must not skip it
+                os.remove(path)
+            except FileNotFoundError:
                 pass
 
     def destroy(self) -> None:

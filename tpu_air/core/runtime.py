@@ -287,8 +287,12 @@ def _sweep_stale_sessions(base: str) -> None:
             if name.startswith("tpu_air-spill-"):
                 # a spill dir's mtime goes stale while its session still
                 # runs (spills may all happen early) — it is reapable only
-                # once the owning store root is gone from every base
+                # once the owning store root is gone from every base.  A
+                # custom store_root (owner not tpu_air-*) lives somewhere we
+                # can't check, so its spill dir is user-managed: never sweep.
                 owner = name[len("tpu_air-spill-"):]
+                if not owner.startswith("tpu_air-"):
+                    continue
                 if any(
                     os.path.exists(os.path.join(b, owner))
                     for b in ("/dev/shm", tempfile.gettempdir())

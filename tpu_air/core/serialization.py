@@ -52,6 +52,15 @@ def deserialize(buf, zero_copy: bool = True) -> Any:
     ``buf`` — the caller must keep ``buf`` alive for the lifetime of the value
     (the object store pins the mmap on the value via a finalizer).
     """
+    return deserialize_ex(buf, zero_copy=zero_copy)[0]
+
+
+def deserialize_ex(buf, zero_copy: bool = True) -> Tuple[Any, int]:
+    """Like :func:`deserialize`, also returning the out-of-band buffer count.
+
+    ``nbuf == 0`` means the value is fully self-contained (no views into
+    ``buf``) — the object store uses this to release its read pin
+    immediately instead of tying it to the value's lifetime."""
     mv = memoryview(buf)
     npickle, nbuf = _HDR.unpack_from(mv, 0)
     off = _HDR.size
@@ -67,7 +76,7 @@ def deserialize(buf, zero_copy: bool = True) -> Any:
         piece = mv[off : off + n]
         oob.append(piece if zero_copy else piece.tobytes())
         off += n
-    return pickle.loads(payload, buffers=oob)
+    return pickle.loads(payload, buffers=oob), nbuf
 
 
 def dumps(value: Any) -> bytes:

@@ -82,7 +82,8 @@ class Arena:
 
     # -- read ---------------------------------------------------------------
     def lookup(self, object_id: str) -> Optional[memoryview]:
-        """Zero-copy view of a sealed object, or None."""
+        """Zero-copy view of a sealed object, or None.  UNPINNED — valid
+        only while the object is not deleted (use for contains/peek)."""
         import ctypes
 
         off = ctypes.c_uint64()
@@ -95,6 +96,31 @@ class Arena:
         # read-only: the store's immutability contract (objects are sealed;
         # readers must not be able to mutate shared memory)
         return self._view[off.value : off.value + size.value].toreadonly()
+
+    def lookup_pin(self, object_id: str) -> Optional[Tuple[memoryview, int]]:
+        """Zero-copy view + PIN: the bytes stay valid across deletes until
+        ``unpin(object_id, offset)``.  Returns (view, offset) or None.  The
+        ownership/ref-counting contract of the native core (plasma analog:
+        reclamation waits for the last reader)."""
+        import ctypes
+
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.arena_lookup_pin(
+            self._h, _key(object_id), ctypes.byref(off), ctypes.byref(size)
+        )
+        if rc != 1:
+            return None
+        view = self._view[off.value : off.value + size.value].toreadonly()
+        return view, off.value
+
+    def unpin(self, object_id: str, offset: int) -> None:
+        """Release one pin.  Safe after close() (no-op on a dead handle)."""
+        if self._h >= 0:
+            self._lib.arena_unpin(self._h, _key(object_id), offset)
+
+    def pins(self, object_id: str) -> int:
+        return int(self._lib.arena_pins(self._h, _key(object_id)))
 
     def contains(self, object_id: str) -> bool:
         return self.lookup(object_id) is not None
@@ -109,6 +135,8 @@ class Arena:
             "used": int(self._lib.arena_used(self._h)),
             "live_objects": int(self._lib.arena_live_objects(self._h)),
             "sealed_bytes": int(self._lib.arena_sealed_bytes(self._h)),
+            "free_bytes": int(self._lib.arena_free_bytes(self._h)),
+            "leaked_bytes": int(self._lib.arena_leaked_bytes(self._h)),
         }
 
     def close(self) -> None:

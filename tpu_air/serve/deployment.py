@@ -203,9 +203,16 @@ class DeploymentHandle:
             self._replicas = [r for r in self._replicas if r is not replica]
 
     def num_replicas(self) -> int:
-        """Count of LIVE replicas.  Prunes dead ones on read so health
-        reporting is accurate even with the restart controller disabled
-        (max_restarts=0) and no traffic since a replica died."""
+        """Cheap rotation size (no liveness probe — used on the request
+        hot path to bound failover retries)."""
+        with self._lock:
+            return len(self._replicas)
+
+    def live_replicas(self) -> int:
+        """Count of LIVE replicas, pruning dead ones.  Used by health/status
+        endpoints so reporting is accurate even with the restart controller
+        disabled (max_restarts=0) and no traffic since a replica died.  Not
+        for the request path: each liveness check takes the runtime lock."""
         with self._lock:
             self._replicas = [r for r in self._replicas if not _actor_dead(r)]
             return len(self._replicas)

@@ -92,7 +92,7 @@ class _Handler(BaseHTTPRequestHandler):
             # per-deployment replica health: degraded (any route with zero
             # live replicas) is a 503 so load balancers can act on it
             detail = {
-                p: {"name": h.deployment_name, "live_replicas": h.num_replicas()}
+                p: {"name": h.deployment_name, "live_replicas": h.live_replicas()}
                 for p, h in _state.routes.items()
             }
             healthy = all(d["live_replicas"] > 0 for d in detail.values())
@@ -211,7 +211,7 @@ def status() -> Dict[str, Any]:
         "deployments": {
             prefix: {
                 "name": h.deployment_name,
-                "num_replicas": h.num_replicas(),
+                "num_replicas": h.live_replicas(),
             }
             for prefix, h in _state.routes.items()
         },
