@@ -220,6 +220,62 @@ def _measure_slope(model, config, params0, batch, enc_len, dec_len, steps_short,
     }
 
 
+def _measure_long_context_attention(seq_len=4096, bh=48, d=64, n=6):
+    """Flash-vs-dense attention forward at long sequence (slope-timed).
+
+    The W1 headline runs at seq 512 where XLA's dense path wins; the Pallas
+    kernel's reason to exist is L >= 2048 where dense attention becomes
+    HBM-bound on the (L, L) score matrix.  Records both paths' TF/s so the
+    round artifact carries the on-chip kernel comparison."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_air.ops.flash_attention import _reference_attention, flash_attention
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (bh, seq_len, d), jnp.bfloat16)
+    k = jax.random.normal(key, (bh, seq_len, d), jnp.bfloat16)
+    v = jax.random.normal(key, (bh, seq_len, d), jnp.bfloat16)
+    flops = 4.0 * bh * seq_len * seq_len * d  # two matmuls, forward only
+
+    def slope(op):
+        def chain(steps):
+            def body(c, _):
+                q, k, v = c
+                return (op(q, k, v), k, v), ()
+
+            @jax.jit
+            def run(q, k, v):
+                (o, _, _), _ = jax.lax.scan(body, (q, k, v), None, length=steps)
+                return jnp.sum(o.astype(jnp.float32))
+
+            return run
+
+        r1, r3 = chain(n), chain(3 * n)
+        float(r1(q, k, v))
+        float(r3(q, k, v))  # compile + warm
+
+        def t(run):
+            t0 = time.perf_counter()
+            float(run(q, k, v))
+            return time.perf_counter() - t0
+
+        t1 = sorted(t(r1) for _ in range(3))[1]
+        t3 = sorted(t(r3) for _ in range(3))[1]
+        return (t3 - t1) / (2 * n)
+
+    td = slope(lambda q, k, v: _reference_attention(q, k, v, None, 1.0, False))
+    tf = slope(lambda q, k, v: flash_attention(q, k, v, scale=1.0, interpret=False))
+    return {
+        "seq_len": seq_len,
+        "bh": bh,
+        "head_dim": d,
+        "dense_tflops": round(flops / td / 1e12, 1),
+        "flash_tflops": round(flops / tf / 1e12, 1),
+        "flash_speedup_vs_dense": round(td / tf, 2),
+    }
+
+
 def _child_main() -> None:
     import jax
 
@@ -263,6 +319,15 @@ def _child_main() -> None:
             # but it must be VISIBLE in the artifact (VERDICT r2 weak 3)
             flash_error = f"{type(e).__name__}: {e}"
             print(f"flash-attention path failed: {flash_error}", file=sys.stderr)
+
+    long_context = long_context_error = None
+    if on_tpu:
+        try:
+            long_context = _measure_long_context_attention()
+        except Exception as e:  # noqa: BLE001 — visible, never fatal
+            long_context_error = f"{type(e).__name__}: {e}"
+            print(f"long-context attention bench failed: {long_context_error}",
+                  file=sys.stderr)
 
     valid_paths = {k: m for k, m in results.items() if not m["problems"]}
     pool = valid_paths or results
@@ -349,6 +414,10 @@ def _child_main() -> None:
     }
     if flash_error:
         result["flash_error"] = flash_error
+    if long_context is not None:
+        result["long_context_attention"] = long_context
+    if long_context_error:
+        result["long_context_error"] = long_context_error
     print(json.dumps(result), flush=True)
 
 

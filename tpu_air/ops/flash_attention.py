@@ -17,11 +17,16 @@ Broadcast-aware operands — the reason a stock kernel doesn't fit T5:
   a (1, BK) additive tile inside VMEM, never an (Lq, Lk) matrix.
 * ``causal``: masking from block-local iota, zero HBM.
 
-f32 accumulation regardless of input dtype.  Backward is an XLA recompute of
-the reference attention (correct VJP for q/k/v/bias; the forward's HBM
-savings are where long-context wins live).  Both the attention output and
-the logsumexp are differentiable, so ring attention (ring_attention.py) can
-train through the merged stats.
+f32 accumulation regardless of input dtype.  BACKWARD is blockwise Pallas
+too (``_pallas_bwd``: a dq pass and a dk/dv pass over saved (out, lse)) —
+O(L) memory end to end, which is what makes long-context TRAINING feasible,
+not just the forward.  Exception: when an additive ``bias`` is present
+(T5's learned relative-position bias) the VJP falls back to an XLA
+recompute of the reference attention, since dbias is dense (H, Lq, Lk)
+regardless.  Both the attention output and the logsumexp are
+differentiable — the lse cotangent folds into the backward's delta term —
+so ring attention (ring_attention.py) trains through merged stats on the
+kernel path.
 """
 
 from __future__ import annotations
@@ -44,6 +49,7 @@ _NEG_INF = -1e30
 
 def _kernel(q_ref, k_ref, v_ref, bias_ref, mask_ref, out_ref, lse_ref,
             acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k):
+    i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -53,34 +59,40 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, mask_ref, out_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0].astype(jnp.float32)  # (BQ, D)
-    k = k_ref[0].astype(jnp.float32)  # (BK, D)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (BQ, BK)
-    if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
-    if mask_ref is not None:
-        # (1, BK) additive key-padding row, broadcast over queries
-        s = s + mask_ref[0].astype(jnp.float32)
-    if causal:
-        i = pl.program_id(1)
-        qi = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        kj = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(qi >= kj, s, _NEG_INF)
+    # Skip tiles entirely above the causal diagonal: p is identically zero
+    # there, so both matmuls and the softmax update are dead work (~2x at
+    # large L).
+    live = (i + 1) * block_q - 1 >= j * block_k if causal else True
 
-    m_prev = m_ref[:, :1]  # (BQ, 1)
-    l_prev = l_ref[:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)  # (BQ, BK)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)  # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)  # (BK, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (BQ, BK)
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if mask_ref is not None:
+            # (1, BK) additive key-padding row, broadcast over queries
+            s = s + mask_ref[0].astype(jnp.float32)
+        if causal:
+            qi = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kj = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qi >= kj, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]  # (BQ, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # (BQ, BK)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -253,6 +265,221 @@ def _reference_attention(q, k, v, bias, scale, causal, kv_mask=None):
 
 
 # --------------------------------------------------------------------------
+# backward kernels (blockwise, O(L) memory — no (Lq, Lk) materialization)
+# --------------------------------------------------------------------------
+#
+# Standard flash-attention backward from the saved (out, lse) statistics:
+#   p_ij  = exp(s_ij - lse_i)
+#   dv_j  = Σ_i p_ij^T · do_i
+#   dp_ij = do_i · v_j^T
+#   ds_ij = p_ij · (dp_ij - Δ_i)        Δ_i = rowsum(do_i ∘ o_i) - glse_i
+#   dq_i  = Σ_j ds_ij · k_j · scale
+#   dk_j  = Σ_i ds_ij^T · q_i · scale
+# The logsumexp cotangent folds into Δ (∂lse_i/∂s_ij = p_ij), which is what
+# lets ring attention train through merged softmax stats with no extra pass.
+# Two kernels because the two accumulations run over different grid axes:
+# dq accumulates across j (j innermost revisits the q tile's scratch), dk/dv
+# across i.  The bias path keeps the XLA recompute backward — T5's learned
+# relative-position bias needs a dense (H, Lq, Lk) dbias regardless.
+
+
+def _bwd_p(s, lse):
+    """exp(s - lse), with MASKED entries hard-zeroed.  f32 can't represent
+    -1e30 + log(klen), so a fully-masked row's lse rounds back to -1e30 and
+    the naive exp gives 1 per entry — klen-times the forward's
+    normalization.  Zeroing keeps such degenerate rows' gradients at 0."""
+    return jnp.where(s <= 0.5 * _NEG_INF, 0.0, jnp.exp(s - lse))
+
+
+def _causal_live(i, j, block_q, block_k):
+    """False iff the (i, j) tile is ENTIRELY above the causal diagonal
+    (max query index < min key index) — its p is identically zero, so both
+    backward matmuls and the exp can be skipped (~2x at large L)."""
+    return (i + 1) * block_q - 1 >= j * block_k
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = _causal_live(i, j, block_q, block_k) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if mask_ref is not None:
+            s = s + mask_ref[0].astype(jnp.float32)
+        if causal:
+            qi = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kj = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qi >= kj, s, _NEG_INF)
+        p = _bwd_p(s, lse_ref[0])                    # (BQ, BK)
+        do = do_ref[0].astype(jnp.float32)           # (BQ, D)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                            # (BQ, BK)
+        ds = p * (dp - delta_ref[0])
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dq_nm(q, k, v, do, lse, delta, dq, acc, **kw):
+    _bwd_dq_kernel(q, k, v, do, lse, delta, None, dq, acc, **kw)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k):
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = _causal_live(i, j, block_q, block_k) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if mask_ref is not None:
+            s = s + mask_ref[0].astype(jnp.float32)
+        if causal:
+            qi = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kj = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qi >= kj, s, _NEG_INF)
+        p = _bwd_p(s, lse_ref[0])                    # (BQ, BK)
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                            # (BK, D)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                    # (BK, D)
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dkv_nm(q, k, v, do, lse, delta, dk, dv, dka, dva, **kw):
+    _bwd_dkv_kernel(q, k, v, do, lse, delta, None, dk, dv, dka, dva, **kw)
+
+
+def _pallas_bwd(q, k, v, kv_mask, out, lse, do, glse, scale, causal,
+                block_q, block_k, interpret):
+    """dq/dk/dv via the blockwise backward.  ``kv_mask`` here is the
+    ADDITIVE form (as in the forward).  Returns f32 grads in input dtype."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    block_q = _auto_block(lq, _AUTO_BLOCK_Q_CAP) if block_q is None else min(block_q, lq)
+    block_k = _auto_block(lk, _AUTO_BLOCK_K_CAP) if block_k is None else min(block_k, lk)
+
+    # Δ_i = rowsum(do ∘ o) - glse_i: O(L·D) precompute, carried as a column
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    if glse is not None:
+        delta = delta - glse.astype(jnp.float32)[..., None]
+    lse_col = lse.astype(jnp.float32)[..., None]     # (bh, lq, 1)
+
+    def mask_spec_args(block_first):
+        if kv_mask is None:
+            return [], []
+        nb = kv_mask.shape[0]
+        if nb == 1:
+            mmap = (lambda b, x, y: (0, 0, y)) if block_first else \
+                   (lambda b, x, y: (0, 0, x))
+        else:
+            h_per = bh // nb
+            mmap = (lambda b, x, y: (b // h_per, 0, y)) if block_first else \
+                   (lambda b, x, y: (b // h_per, 0, x))
+        return ([pl.BlockSpec((1, 1, block_k), mmap)], [kv_mask[:, None, :]])
+
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+
+    # pass 1: dq — grid (bh, i, j), j innermost accumulates into dq scratch
+    mspecs, margs = mask_spec_args(block_first=True)
+    dq_kernel = _bwd_dq_kernel if kv_mask is not None else _bwd_dq_nm
+    (dq,) = pl.pallas_call(
+        functools.partial(dq_kernel, **kw),
+        grid=(bh, lq // block_q, lk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),   # lse
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),   # delta
+            *mspecs,
+        ],
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, lq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_col, delta, *margs)
+
+    # pass 2: dk/dv — grid (bh, j, i), i innermost accumulates into scratch
+    mspecs, margs = mask_spec_args(block_first=False)
+    dkv_kernel = _bwd_dkv_kernel if kv_mask is not None else _bwd_dkv_nm
+    dk, dv = pl.pallas_call(
+        functools.partial(dkv_kernel, **kw),
+        grid=(bh, lk // block_k, lq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),   # lse
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),   # delta
+            *mspecs,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse_col, delta, *margs)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
 # differentiable entry (custom VJP over both outputs)
 # --------------------------------------------------------------------------
 
@@ -265,14 +492,28 @@ def _flash_pair(q, k, v, bias, kv_mask, scale, causal, block_q, block_k, interpr
 
 def _flash_pair_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k,
                     interpret):
-    out = _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k,
-                      interpret)
-    return out, (q, k, v, bias, kv_mask)
+    out, lse = _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q,
+                           block_k, interpret)
+    return (out, lse), (q, k, v, bias, kv_mask, out, lse)
 
 
 def _flash_pair_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v, bias, kv_mask = res
+    q, k, v, bias, kv_mask, out, lse = res
+    do, glse = g
 
+    if bias is None:
+        # blockwise backward: O(L) memory, no (Lq, Lk) materialization —
+        # this is what makes long-context training (ring attention / SP)
+        # memory-feasible, not just the forward
+        dq, dk, dv = _pallas_bwd(
+            q, k, v, kv_mask, out, lse, do, glse, scale, causal,
+            block_q, block_k, interpret,
+        )
+        dmask = None if kv_mask is None else jnp.zeros_like(kv_mask)
+        return dq, dk, dv, None, dmask
+
+    # bias path (T5 relative-position bias): the learned bias needs a dense
+    # (H, Lq, Lk) gradient anyway — recompute through the XLA reference
     def f(q, k, v, bias):
         return _reference_pair(q, k, v, bias, kv_mask, scale, causal)
 

@@ -10,9 +10,11 @@ exact attention output — no device ever materializes more than
 O((L/P)² ) scores, and the rotation overlaps with compute under XLA's
 async collective scheduling.
 
-Causal masking works on global positions: each ring step knows which K
-shard it holds (source device index), so the mask/bias tile is built from
-global offsets.
+Causal masking works by HOP TYPE: shards are contiguous global slices, so
+each ring step is either the diagonal (local causal mask inside the
+kernel), fully visible (no mask), or fully masked (kernel skipped
+entirely — and its ~P/2 of the hops' compute saved).  No additive bias is
+ever built, which keeps the blockwise Pallas backward on the training path.
 
 Composable with data/tensor parallelism: just name a ``sequence`` axis in
 the mesh and shard L over it (see tests/test_ops.py for the shard_map
@@ -64,24 +66,33 @@ def ring_attention(
     my = jax.lax.axis_index(axis_name)
     l_local = q.shape[1]
 
-    def mask_bias(kv_owner):
-        """Additive causal bias for this step: query global rows my*L..,
-        key global cols kv_owner*L.. (−inf above the diagonal)."""
-        qi = my * l_local + jax.lax.broadcasted_iota(jnp.int32, (l_local, l_local), 0)
-        kj = kv_owner * l_local + jax.lax.broadcasted_iota(
-            jnp.int32, (l_local, l_local), 1
-        )
-        return jnp.where(qi >= kj, 0.0, -1e30)[None].astype(jnp.float32)
-
     def step(carry, _):
         out, lse, kv_k, kv_v, owner = carry
-        # (1, L, L) bias — the kernel's BlockSpec replays it per batch·head,
-        # so the mask is never materialized at batch size
-        bias = mask_bias(owner) if causal else None
-        o_i, lse_i = flash_attention_with_lse(
-            q, kv_k, kv_v, bias, scale=scale, causal=False,
-            block_q=block_q, block_k=block_k,
-        )
+        # Causality by HOP TYPE, not by an additive bias: the shards are
+        # contiguous global slices, so a hop is (a) the diagonal
+        # (owner == my: plain local causal), (b) fully visible (owner < my),
+        # or (c) fully masked (owner > my: skip the kernel entirely).
+        # Keeping ``bias=None`` is load-bearing — the bias path falls back
+        # to the dense-recompute VJP, while these branches keep the
+        # blockwise Pallas BACKWARD (O(L) memory) on the training path.
+        kw = dict(scale=scale, block_q=block_q, block_k=block_k)
+
+        def diagonal(q, kk, vv):
+            return flash_attention_with_lse(q, kk, vv, causal=True, **kw)
+
+        def visible(q, kk, vv):
+            return flash_attention_with_lse(q, kk, vv, causal=False, **kw)
+
+        def masked(q, kk, vv):
+            return (jnp.zeros(q.shape, q.dtype),
+                    jnp.full(q.shape[:2], -1e30, jnp.float32))
+
+        if causal:
+            branch = jnp.where(owner == my, 0, jnp.where(owner < my, 1, 2))
+            o_i, lse_i = jax.lax.switch(branch, [diagonal, visible, masked],
+                                        q, kv_k, kv_v)
+        else:
+            o_i, lse_i = visible(q, kv_k, kv_v)
         out, lse = _merge(out, lse, o_i, lse_i)
         # rotate K/V to the next device on the ring (neighbor ICI hop)
         perm = [(i, (i + 1) % p) for i in range(p)]
