@@ -276,6 +276,47 @@ def _measure_long_context_attention(seq_len=4096, bh=48, d=64, n=6):
     }
 
 
+def _measure_generation(model, config, params, batch=256, enc_len=512,
+                        max_new_tokens=128):
+    """W3 batch-generation throughput (seq/sec/chip): greedy KV-cache decode
+    at the reference's dials (batch_size=256, max_new_tokens=128 —
+    Model_finetuning_and_batch_inference.ipynb:cc-67)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_air.models.t5.generate import make_generate_fn
+
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (batch, enc_len), 2, config.vocab_size, jnp.int32)
+    mask = jnp.ones((batch, enc_len), jnp.int32)
+    fn = make_generate_fn(model, max_new_tokens, False, 1.0, 0)
+    int(jnp.sum(fn(params, ids, mask, rng)))  # compile + warm
+
+    def one():
+        t0 = time.perf_counter()
+        int(jnp.sum(fn(params, ids, mask, rng)))  # token checksum = sync
+        return time.perf_counter() - t0
+
+    t1 = sorted(one() for _ in range(3))[1]
+    # slope sanity: two back-to-back calls; the marginal call must cost
+    # about one call (a sync that lies shows up as marginal << single)
+    t0 = time.perf_counter()
+    int(jnp.sum(fn(params, ids, mask, rng)))
+    int(jnp.sum(fn(params, ids, mask, rng)))
+    marginal = (time.perf_counter() - t0) - t1
+    valid = marginal > 0.5 * t1
+    per = marginal if valid else t1
+    return {
+        "batch": batch,
+        "enc_len": enc_len,
+        "max_new_tokens": max_new_tokens,
+        "seq_per_sec": round(batch / per, 1),
+        "new_tokens_per_sec": round(batch * max_new_tokens / per, 1),
+        "call_s": round(per, 3),
+        "measurement_valid": valid,
+    }
+
+
 def _child_main() -> None:
     import jax
 
@@ -321,6 +362,7 @@ def _child_main() -> None:
             print(f"flash-attention path failed: {flash_error}", file=sys.stderr)
 
     long_context = long_context_error = None
+    generation = generation_error = None
     if on_tpu:
         try:
             long_context = _measure_long_context_attention()
@@ -328,6 +370,11 @@ def _child_main() -> None:
             long_context_error = f"{type(e).__name__}: {e}"
             print(f"long-context attention bench failed: {long_context_error}",
                   file=sys.stderr)
+        try:
+            generation = _measure_generation(model, config, params)
+        except Exception as e:  # noqa: BLE001 — visible, never fatal
+            generation_error = f"{type(e).__name__}: {e}"
+            print(f"generation bench failed: {generation_error}", file=sys.stderr)
 
     valid_paths = {k: m for k, m in results.items() if not m["problems"]}
     pool = valid_paths or results
@@ -418,6 +465,10 @@ def _child_main() -> None:
         result["long_context_attention"] = long_context
     if long_context_error:
         result["long_context_error"] = long_context_error
+    if generation is not None:
+        result["generation"] = generation
+    if generation_error:
+        result["generation_error"] = generation_error
     print(json.dumps(result), flush=True)
 
 

@@ -1,0 +1,101 @@
+"""Long-context LM training with first-class sequence parallelism.
+
+The capability the reference stack caps at 512 tokens
+(NLP_workloads/Anyscale_job/utils.py:23-28 pads/truncates to T5's
+model_max_length): here context length scales with a ``sequence`` mesh axis.
+Each device holds L/P tokens; attention is ring attention (K/V rotate over
+ICI via ppermute, ops/ring_attention.py) built on the Pallas flash kernels —
+forward AND backward are blockwise, so per-device attention memory stays
+O((L/P)^2) for activations and O(L/P) inside the kernels at every step.
+
+Offline + CPU-friendly by default: synthesizes token streams and runs on the
+virtual device mesh.  On a real slice the same code runs with chips on the
+mesh axes.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/long_context_lm.py --seq-len 512 --sp 2 --steps 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=512,
+                    help="GLOBAL context length (sharded over the sp axis)")
+    ap.add_argument("--sp", type=int, default=2, help="sequence-parallel degree")
+    ap.add_argument("--dp", type=int, default=None, help="data-parallel degree")
+    ap.add_argument("--batch", type=int, default=4, help="global batch size")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--n-layers", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_air.models.lm import LMConfig
+    from tpu_air.parallel.sequence_parallel import (
+        init_sp_params,
+        make_sp_mesh,
+        make_sp_train_step,
+        shard_batch,
+        shift_targets,
+    )
+
+    config = LMConfig(
+        vocab_size=512,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=4,
+        max_seq_len=args.seq_len,
+    )
+    mesh = make_sp_mesh(dp=args.dp, sp=args.sp)
+    dp, sp = mesh.shape["data"], mesh.shape["sequence"]
+    print(f"mesh: dp={dp} x sp={sp} over {dp * sp} devices; "
+          f"global seq {args.seq_len} -> {args.seq_len // sp} tokens/device")
+
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(1e-3))
+    step, _ = make_sp_train_step(config, mesh, tx)
+    params = init_sp_params(config, mesh, seed=0)
+    opt_state = jax.device_put(
+        tx.init(params),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )
+
+    # synthetic corpus: structured enough that next-token loss can drop
+    # (periodic sequences with per-row phase), generated offline
+    rng = jax.random.PRNGKey(0)
+    period = 17
+    phase = jax.random.randint(rng, (args.batch, 1), 0, period)
+    base = jnp.arange(args.seq_len, dtype=jnp.int32)[None, :]
+    input_ids = 2 + ((base + phase) % period)
+
+    targets = shift_targets(input_ids, config.pad_token_id)
+    input_ids, targets = shard_batch(mesh, input_ids, targets)
+
+    losses = []
+    for i in range(args.steps):
+        t0 = time.time()
+        params, opt_state, loss = step(params, opt_state, input_ids, targets)
+        losses.append(float(loss))
+        tag = " (compile)" if i == 0 else ""
+        print(f"step {i}: loss={losses[-1]:.4f}  [{time.time() - t0:.2f}s]{tag}")
+    first, best = losses[0], min(losses)
+    loss = losses[-1]
+    if not best < first:
+        print(f"loss did not improve: {first:.4f} -> best {best:.4f}")
+        return 1
+    toks = args.batch * args.seq_len
+    print(f"sequence-parallel training OK: {toks} tokens/step over "
+          f"{dp * sp} devices, loss {first:.4f} -> {loss:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,3 +95,14 @@ def test_strict_and_smoke_are_mutually_exclusive():
     )
     assert proc.returncode != 0
     assert "mutually exclusive" in proc.stderr
+
+
+@pytest.mark.slow
+def test_long_context_lm_example():
+    """W-beyond: sequence-parallel long-context LM training (ring attention
+    + Pallas kernels) on the virtual mesh — the capability the reference
+    caps at 512 tokens."""
+    proc = _run_example("long_context_lm.py", "--seq-len", "256", "--sp", "2",
+                        "--steps", "8")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "sequence-parallel training OK" in proc.stdout

@@ -62,7 +62,7 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, mask_ref, out_ref, lse_ref,
     # Skip tiles entirely above the causal diagonal: p is identically zero
     # there, so both matmuls and the softmax update are dead work (~2x at
     # large L).
-    live = (i + 1) * block_q - 1 >= j * block_k if causal else True
+    live = _causal_live(i, j, block_q, block_k) if causal else True
 
     @pl.when(live)
     def _body():
