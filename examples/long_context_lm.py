@@ -27,7 +27,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq-len", type=int, default=512,
                     help="GLOBAL context length (sharded over the sp axis)")
-    ap.add_argument("--sp", type=int, default=2, help="sequence-parallel degree")
+    ap.add_argument("--sp", type=int, default=None,
+                    help="sequence-parallel degree (default: auto-pick a divisor\n                    of the visible device count)")
     ap.add_argument("--dp", type=int, default=None, help="data-parallel degree")
     ap.add_argument("--batch", type=int, default=4, help="global batch size")
     ap.add_argument("--steps", type=int, default=8)

@@ -139,3 +139,83 @@ def test_sp_grads_match_single_device(batch):
     flat_s, _ = jax.flatten_util.ravel_pytree(gs)
     np.testing.assert_allclose(np.asarray(flat_d), np.asarray(flat_s),
                                rtol=5e-4, atol=5e-4)
+
+
+def test_chunked_head_loss_matches_dense():
+    """lm_chunked_loss_with_targets (no (B,L,V) logits materialization) is
+    numerically the dense head + CE, in value AND gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_air.models.lm import (
+        CausalLM,
+        LMConfig,
+        head_weight,
+        lm_chunked_loss_with_targets,
+        lm_loss_with_targets,
+    )
+
+    cfg = LMConfig.tiny()
+    model = CausalLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    B, L = 2, 64
+    ids = jax.random.randint(rng, (B, L), 2, cfg.vocab_size, jnp.int32)
+    targets = jnp.concatenate(
+        [ids[:, 1:], jnp.full((B, 1), cfg.pad_token_id, ids.dtype)], axis=1
+    )
+    params = model.init(rng, ids)["params"]
+
+    def dense(p):
+        logits = model.apply({"params": p}, ids)
+        s, c = lm_loss_with_targets(logits, targets, cfg.pad_token_id)
+        return s / c
+
+    def chunked(p):
+        hidden = model.apply({"params": p}, ids, return_hidden=True)
+        s, c = lm_chunked_loss_with_targets(
+            hidden, head_weight(p, cfg), targets, cfg.pad_token_id, chunk_size=16
+        )
+        return s / c
+
+    ld, gd = jax.value_and_grad(dense)(params)
+    lc, gc = jax.value_and_grad(chunked)(params)
+    assert abs(float(ld) - float(lc)) < 1e-5, (ld, lc)
+    flat_d = jax.tree_util.tree_leaves(gd)
+    flat_c = jax.tree_util.tree_leaves(gc)
+    for a, b in zip(flat_d, flat_c):
+        import numpy as np
+
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_chunked_head_loss_pads_non_divisible_lengths():
+    """A non-chunk-multiple length must keep the chunked (padded) path and
+    still match the dense loss exactly — not silently fall back to dense."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_air.models.lm import (
+        CausalLM,
+        LMConfig,
+        head_weight,
+        lm_chunked_loss_with_targets,
+        lm_loss_with_targets,
+    )
+
+    cfg = LMConfig.tiny()
+    model = CausalLM(cfg)
+    rng = jax.random.PRNGKey(3)
+    B, L = 2, 50  # 50 % 16 != 0
+    ids = jax.random.randint(rng, (B, L), 2, cfg.vocab_size, jnp.int32)
+    targets = jnp.concatenate(
+        [ids[:, 1:], jnp.full((B, 1), cfg.pad_token_id, ids.dtype)], axis=1
+    )
+    params = model.init(rng, ids)["params"]
+    hidden = model.apply({"params": params}, ids, return_hidden=True)
+    s1, c1 = lm_chunked_loss_with_targets(
+        hidden, head_weight(params, cfg), targets, cfg.pad_token_id, chunk_size=16
+    )
+    logits = model.apply({"params": params}, ids)
+    s2, c2 = lm_loss_with_targets(logits, targets, cfg.pad_token_id)
+    assert abs(float(s1) - float(s2)) < 1e-3 and float(c1) == float(c2)

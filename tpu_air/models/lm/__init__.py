@@ -1,4 +1,17 @@
 from .config import LMConfig
-from .modeling import CausalLM, lm_loss, lm_loss_with_targets
+from .modeling import (
+    CausalLM,
+    head_weight,
+    lm_chunked_loss_with_targets,
+    lm_loss,
+    lm_loss_with_targets,
+)
 
-__all__ = ["LMConfig", "CausalLM", "lm_loss", "lm_loss_with_targets"]
+__all__ = [
+    "LMConfig",
+    "CausalLM",
+    "head_weight",
+    "lm_chunked_loss_with_targets",
+    "lm_loss",
+    "lm_loss_with_targets",
+]

@@ -158,7 +158,7 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids: Array, positions: Optional[Array] = None,
-                 deterministic: bool = True) -> Array:
+                 deterministic: bool = True, return_hidden: bool = False) -> Array:
         cfg = self.config
         b, l = input_ids.shape
         if l > cfg.max_seq_len:
@@ -176,6 +176,12 @@ class CausalLM(nn.Module):
         for i in range(cfg.n_layers):
             x = Block(cfg, name=f"layer_{i}")(x, positions, deterministic)
         x = RMSNorm(cfg.rmsnorm_eps, dtype, name="final_norm")(x)
+        if return_hidden:
+            # pre-head hidden states: pair with head_weight() +
+            # lm_chunked_loss_with_targets so the (B, L, V) logits are never
+            # materialized (the other long-context memory cliff besides
+            # attention; at L=8k, V=50k that tensor alone is GBs)
+            return x
         if cfg.tie_embeddings:
             logits = x.astype(jnp.float32) @ embed.T
         else:
@@ -184,10 +190,53 @@ class CausalLM(nn.Module):
         return logits
 
 
+def head_weight(params, config: LMConfig) -> Array:
+    """The (d_model, vocab) head matrix out of a CausalLM param tree."""
+    if config.tie_embeddings:
+        return params["embedding"].T
+    return params["lm_head"]["kernel"]
+
+
 def lm_loss(logits: Array, input_ids: Array, pad_token_id: int):
     """Next-token cross entropy over non-pad targets; returns (sum, count)
     so sequence-parallel callers can psum both before dividing."""
     return lm_loss_with_targets(logits[:, :-1], input_ids[:, 1:], pad_token_id)
+
+
+def lm_chunked_loss_with_targets(hidden: Array, head_w: Array, targets: Array,
+                                 pad_token_id: int, chunk_size: int = 512):
+    """CE without materializing the (B, L, V) logits.
+
+    Scans over sequence chunks; each chunk's logits exist only inside the
+    (rematerialized) chunk body, so peak memory is O(B·chunk·V) in both the
+    forward and the backward instead of O(B·L·V) — the lm-head analog of
+    blockwise attention, and the second memory cliff of long-context
+    training.  Returns (sum, count) like :func:`lm_loss_with_targets`."""
+    b, l, d = hidden.shape
+    chunk_size = min(chunk_size, l)
+    if l % chunk_size:
+        # pad to a chunk multiple — padded targets are pad_token_id, so they
+        # are masked out and contribute (0, 0).  Never fall back to the
+        # dense (B, L, V) head: odd lengths show up exactly in the
+        # long-context regime this function exists for.
+        padded = (l + chunk_size - 1) // chunk_size * chunk_size
+        hidden = jnp.pad(hidden, ((0, 0), (0, padded - l), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, padded - l)),
+                          constant_values=pad_token_id)
+        l = padded
+    n = l // chunk_size
+    hs = hidden.reshape(b, n, chunk_size, d).transpose(1, 0, 2, 3)
+    ts = targets.reshape(b, n, chunk_size).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk(carry, xt):
+        h, t = xt
+        logits = h.astype(jnp.float32) @ head_w.astype(jnp.float32)
+        s, c = lm_loss_with_targets(logits, t, pad_token_id)
+        return (carry[0] + s, carry[1] + c), None
+
+    (s, c), _ = jax.lax.scan(chunk, (jnp.float32(0), jnp.float32(0)), (hs, ts))
+    return s, c
 
 
 def lm_loss_with_targets(logits: Array, targets: Array, pad_token_id: int):

@@ -24,7 +24,12 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_air.models.lm import CausalLM, LMConfig, lm_loss_with_targets
+from tpu_air.models.lm import (
+    CausalLM,
+    LMConfig,
+    head_weight,
+    lm_chunked_loss_with_targets,
+)
 from tpu_air.parallel.mesh import make_mesh, visible_devices
 from tpu_air.parallel.shardmap_compat import shard_map_unchecked as _shard_map
 
@@ -84,9 +89,15 @@ def make_sp_train_step(
         # unchecked-replication mode, where psum's transpose psums the
         # cotangent again (a P-factor error).  loss = S_total / C_total with
         # C independent of params, so grad = psum(dS_local) / C_total.
+        # The head is CHUNKED (lm_chunked_loss_with_targets): the local
+        # (B, L/P, V) logits never materialize — blockwise attention fixes
+        # one long-context memory cliff, this fixes the other.
         def loss_fn(p):
-            logits = model.apply({"params": p}, input_ids, positions)
-            s, c = lm_loss_with_targets(logits, targets, pad)
+            hidden = model.apply({"params": p}, input_ids, positions,
+                                 return_hidden=True)
+            s, c = lm_chunked_loss_with_targets(
+                hidden, head_weight(p, cfg), targets, pad
+            )
             return s, c
 
         (s_local, c_local), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
