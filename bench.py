@@ -220,6 +220,16 @@ def _measure_slope(model, config, params0, batch, enc_len, dec_len, steps_short,
     }
 
 
+def _med3(fn) -> float:
+    """Median of three timed calls of a zero-arg fn returning nothing."""
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[1]
+
+
 def _measure_long_context_attention(seq_len=4096, bh=48, d=64, n=6):
     """Flash-vs-dense attention forward at long sequence (slope-timed).
 
@@ -254,14 +264,8 @@ def _measure_long_context_attention(seq_len=4096, bh=48, d=64, n=6):
         r1, r3 = chain(n), chain(3 * n)
         float(r1(q, k, v))
         float(r3(q, k, v))  # compile + warm
-
-        def t(run):
-            t0 = time.perf_counter()
-            float(run(q, k, v))
-            return time.perf_counter() - t0
-
-        t1 = sorted(t(r1) for _ in range(3))[1]
-        t3 = sorted(t(r3) for _ in range(3))[1]
+        t1 = _med3(lambda: float(r1(q, k, v)))
+        t3 = _med3(lambda: float(r3(q, k, v)))
         return (t3 - t1) / (2 * n)
 
     td = slope(lambda q, k, v: _reference_attention(q, k, v, None, 1.0, False))
@@ -291,13 +295,8 @@ def _measure_generation(model, config, params, batch=256, enc_len=512,
     mask = jnp.ones((batch, enc_len), jnp.int32)
     fn = make_generate_fn(model, max_new_tokens, False, 1.0, 0)
     int(jnp.sum(fn(params, ids, mask, rng)))  # compile + warm
-
-    def one():
-        t0 = time.perf_counter()
-        int(jnp.sum(fn(params, ids, mask, rng)))  # token checksum = sync
-        return time.perf_counter() - t0
-
-    t1 = sorted(one() for _ in range(3))[1]
+    # token checksum forces a real device sync per call
+    t1 = _med3(lambda: int(jnp.sum(fn(params, ids, mask, rng))))
     # slope sanity: two back-to-back calls; the marginal call must cost
     # about one call (a sync that lies shows up as marginal << single)
     t0 = time.perf_counter()

@@ -291,6 +291,32 @@ def _bwd_p(s, lse):
     return jnp.where(s <= 0.5 * _NEG_INF, 0.0, jnp.exp(s - lse))
 
 
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+              i, j, scale, causal, block_q, block_k):
+    """Shared per-tile backward computation: recompute scores with the SAME
+    masking as the forward (single source of truth), then p and ds.
+    Returns (q, k, do, p, ds), all f32."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if mask_ref is not None:
+        s = s + mask_ref[0].astype(jnp.float32)
+    if causal:
+        qi = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kj = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(qi >= kj, s, _NEG_INF)
+    p = _bwd_p(s, lse_ref[0])                        # (BQ, BK)
+    do = do_ref[0].astype(jnp.float32)               # (BQ, D)
+    dp = jax.lax.dot_general(
+        do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                # (BQ, BK)
+    ds = p * (dp - delta_ref[0])
+    return q, k, do, p, ds
+
+
 def _causal_live(i, j, block_q, block_k):
     """False iff the (i, j) tile is ENTIRELY above the causal diagonal
     (max query index < min key index) — its p is identically zero, so both
@@ -312,24 +338,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
 
     @pl.when(live)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if mask_ref is not None:
-            s = s + mask_ref[0].astype(jnp.float32)
-        if causal:
-            qi = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            kj = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(qi >= kj, s, _NEG_INF)
-        p = _bwd_p(s, lse_ref[0])                    # (BQ, BK)
-        do = do_ref[0].astype(jnp.float32)           # (BQ, D)
-        dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                            # (BQ, BK)
-        ds = p * (dp - delta_ref[0])
+        _, k, _, _, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                   delta_ref, mask_ref, i, j, scale, causal,
+                                   block_q, block_k)
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -359,27 +370,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
 
     @pl.when(live)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if mask_ref is not None:
-            s = s + mask_ref[0].astype(jnp.float32)
-        if causal:
-            qi = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            kj = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(qi >= kj, s, _NEG_INF)
-        p = _bwd_p(s, lse_ref[0])                    # (BQ, BK)
-        do = do_ref[0].astype(jnp.float32)
+        q, _, do, p, ds = _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                    delta_ref, mask_ref, i, j, scale, causal,
+                                    block_q, block_k)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )                                            # (BK, D)
-        dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_ref[0])
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                    # (BK, D)
