@@ -106,3 +106,12 @@ def test_long_context_lm_example():
                         "--steps", "8")
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "sequence-parallel training OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_inference_architectures_example():
+    """W7: the reference's five-architecture comparison arc
+    (Scaling_batch_inference.ipynb:cc-136) runs end to end."""
+    proc = _run_example("inference_architectures.py", "--images", "12")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "vs sequential" in proc.stdout and "BatchPredictor" in proc.stdout
