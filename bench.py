@@ -580,11 +580,16 @@ def main() -> None:
             }
             # carry the most recent VALID on-hardware measurement so a
             # transient tunnel wedge at artifact time doesn't erase the
-            # round's real headline (it is labeled as prior, not current)
-            for metric, prev in _load_last().items():
-                if prev.get("platform") == "tpu" and prev.get("measurement_valid"):
-                    result["last_valid_tpu"] = prev
-                    break
+            # round's real headline (it is labeled as prior, not current);
+            # newest by recorded_at stamp, never just file order
+            tpu_entries = [
+                prev for prev in _load_last().values()
+                if prev.get("platform") == "tpu" and prev.get("measurement_valid")
+            ]
+            if tpu_entries:
+                result["last_valid_tpu"] = max(
+                    tpu_entries, key=lambda p: p.get("recorded_at", 0.0)
+                )
     if not result:
         result = {
             "metric": "bench-harness-failure",
@@ -600,7 +605,9 @@ def main() -> None:
         # persisted as a future comparison point
         try:
             last = _load_last()
-            last[result["metric"]] = result
+            result_stamped = dict(result)
+            result_stamped["recorded_at"] = time.time()
+            last[result["metric"]] = result_stamped
             with open(_LAST_PATH, "w") as f:
                 json.dump(last, f)
         except Exception:

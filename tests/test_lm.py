@@ -219,3 +219,53 @@ def test_chunked_head_loss_pads_non_divisible_lengths():
     logits = model.apply({"params": params}, ids)
     s2, c2 = lm_loss_with_targets(logits, targets, cfg.pad_token_id)
     assert abs(float(s1) - float(s2)) < 1e-3 and float(c1) == float(c2)
+
+
+def test_lm_trainer_sequence_parallel_fit(air):
+    """VERDICT-style Trainer coherence for SP: long-context training is a
+    ScalingConfig field (sequence_parallel=N) through the standard
+    fit() -> Result -> Checkpoint contract, not a bespoke script."""
+    import numpy as np
+
+    import tpu_air.data as tad
+    from tpu_air.models.lm import LMConfig
+    from tpu_air.train import (
+        CheckpointConfig,
+        LMTrainer,
+        RunConfig,
+        ScalingConfig,
+        TrainingArguments,
+    )
+
+    rng = np.random.default_rng(0)
+    period, L = 17, 64
+    rows = [
+        {"input_ids": (2 + (np.arange(L) + int(rng.integers(period))) % period)
+                      .astype(np.int32).tolist()}
+        for _ in range(32)
+    ]
+    ds = tad.from_items(rows)
+    trainer = LMTrainer(
+        model_config=LMConfig.tiny(),
+        training_args=TrainingArguments(
+            learning_rate=1e-3, per_device_train_batch_size=2,
+            num_train_epochs=2, max_steps_per_epoch=4,
+        ),
+        scaling_config=ScalingConfig(num_workers=2, sequence_parallel=2),
+        datasets={"train": ds, "evaluation": ds.limit(8)},
+        run_config=RunConfig(
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=1, checkpoint_score_attribute="eval_loss",
+                checkpoint_score_order="min",
+            )
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    m = result.metrics
+    assert m["mesh_sequence"] == 2 and m["mesh_data"] >= 1
+    assert np.isfinite(m["loss"]) and np.isfinite(m["eval_loss"])
+    assert result.checkpoint is not None
+    # the checkpoint round-trips params + config
+    cfg = result.checkpoint._load_model_config()
+    assert cfg.vocab_size == LMConfig.tiny().vocab_size

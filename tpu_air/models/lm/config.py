@@ -46,6 +46,13 @@ class LMConfig:
     def to_dict(self) -> dict:
         return asdict(self)
 
+    def to_json(self) -> str:
+        """Checkpoint serialization (train/checkpoint.py model_config.json);
+        ``model_type`` tags the config class for reconstruction."""
+        import json
+
+        return json.dumps({**self.to_dict(), "model_type": "causal_lm"})
+
     @classmethod
     def from_dict(cls, d: dict) -> "LMConfig":
         return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})

@@ -62,6 +62,26 @@ def shift_targets(input_ids: jax.Array, pad_token_id: int) -> jax.Array:
     )
 
 
+def sp_local_loss(model, params, input_ids, targets, seq_axis: str = "sequence"):
+    """The per-shard unnormalized loss every SP consumer shares (train step
+    and eval): global RoPE positions from the shard index, hidden states via
+    ``return_hidden``, and the CHUNKED lm-head CE
+    (lm_chunked_loss_with_targets) so the local (B, L/P, V) logits never
+    materialize — blockwise attention fixes one long-context memory cliff,
+    this fixes the other.  Returns local (sum, count)."""
+    li = input_ids.shape[1]  # local shard length
+    offset = jax.lax.axis_index(seq_axis) * li
+    positions = jnp.broadcast_to(
+        offset + jnp.arange(li, dtype=jnp.int32), input_ids.shape
+    )
+    hidden = model.apply({"params": params}, input_ids, positions,
+                         return_hidden=True)
+    return lm_chunked_loss_with_targets(
+        hidden, head_weight(params, model.config), targets,
+        model.config.pad_token_id,
+    )
+
+
 def make_sp_train_step(
     config: LMConfig,
     mesh: Mesh,
@@ -75,30 +95,15 @@ def make_sp_train_step(
     cfg = LMConfig.from_dict({**config.to_dict(),
                               "attention": "ring", "sequence_axis": seq_axis})
     model = CausalLM(cfg)
-    pad = cfg.pad_token_id
 
     def local_step(params, opt_state, input_ids, targets):
-        li = input_ids.shape[1]  # local shard length
-        offset = jax.lax.axis_index(seq_axis) * li
-        positions = jnp.broadcast_to(
-            offset + jnp.arange(li, dtype=jnp.int32), input_ids.shape
-        )
-
         # Differentiate the LOCAL unnormalized loss and reduce outside the
         # grad: putting psum inside loss_fn is wrong under shard_map's
         # unchecked-replication mode, where psum's transpose psums the
         # cotangent again (a P-factor error).  loss = S_total / C_total with
         # C independent of params, so grad = psum(dS_local) / C_total.
-        # The head is CHUNKED (lm_chunked_loss_with_targets): the local
-        # (B, L/P, V) logits never materialize — blockwise attention fixes
-        # one long-context memory cliff, this fixes the other.
         def loss_fn(p):
-            hidden = model.apply({"params": p}, input_ids, positions,
-                                 return_hidden=True)
-            s, c = lm_chunked_loss_with_targets(
-                hidden, head_weight(p, cfg), targets, pad
-            )
-            return s, c
+            return sp_local_loss(model, p, input_ids, targets, seq_axis)
 
         (s_local, c_local), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         c_total = jnp.maximum(jax.lax.psum(c_local, (data_axis, seq_axis)), 1.0)

@@ -6,6 +6,7 @@ from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from .gbdt_trainer import GBDTTrainer, XGBoostTrainer
 from .result import Result
 from .session import get_dataset_shard, get_session, report
+from .lm_trainer import LMTrainer, lm_train_loop
 from .segformer_trainer import SegformerTrainer, segformer_train_loop
 from .t5_trainer import T5Trainer, TrainingArguments, t5_train_loop
 from .trainer import BaseTrainer, JaxTrainer
@@ -20,6 +21,7 @@ __all__ = [
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "LMTrainer",
     "SegformerTrainer",
     "T5Trainer",
     "TrainingArguments",

@@ -173,6 +173,10 @@ class Checkpoint:
             from tpu_air.models.segformer import SegformerConfig
 
             return SegformerConfig.from_dict(d)
+        if d.get("model_type") == "causal_lm":
+            from tpu_air.models.lm import LMConfig
+
+            return LMConfig.from_dict(d)
         from tpu_air.models.t5 import T5Config
 
         return T5Config.from_dict(d)
@@ -218,17 +222,19 @@ class Checkpoint:
         if dtype:
             config.dtype = dtype
         if model_cls is None:
+            from tpu_air.models.lm import CausalLM, LMConfig
             from tpu_air.models.segformer import (
                 SegformerConfig,
                 SegformerForSemanticSegmentation,
             )
             from tpu_air.models.t5 import T5ForConditionalGeneration
 
-            model_cls = (
-                SegformerForSemanticSegmentation
-                if isinstance(config, SegformerConfig)
-                else T5ForConditionalGeneration
-            )
+            if isinstance(config, SegformerConfig):
+                model_cls = SegformerForSemanticSegmentation
+            elif isinstance(config, LMConfig):
+                model_cls = CausalLM
+            else:
+                model_cls = T5ForConditionalGeneration
         model = model_cls(config)
         return model, self.get_params(dtype=None, sharding=sharding)
 

@@ -33,6 +33,12 @@ class ScalingConfig:
     # but the north-star FLAN-T5-XL cannot run replicated — TP is a config
     # change here, per SURVEY.md §7's mesh stance.
     model_parallel: Optional[int] = None
+    # Sequence-parallel degree (long-context): each data-parallel worker's
+    # CONTEXT is sharded over this many chips (the ``sequence`` mesh axis;
+    # ring attention over ICI — ops/ring_attention.py).  Absent from the
+    # reference (SURVEY.md §2C SP row: explicit non-goal there) but
+    # first-class here; consumed by LMTrainer.
+    sequence_parallel: Optional[int] = None
     topology: Optional[str] = None  # e.g. "v4-32"; informational for placement
     resources_per_worker: Optional[Dict[str, float]] = None
     # GPU-era alias accepted for drop-in compatibility (cc-40's use_gpu=True)
@@ -41,18 +47,23 @@ class ScalingConfig:
     def __post_init__(self):
         if self.use_gpu is not None:
             self.use_tpu = bool(self.use_gpu)
-        if self.model_parallel is not None:
-            if self.model_parallel < 1:
-                raise ValueError("model_parallel must be >= 1")
-            if self.num_chips_per_worker == 1:
-                self.num_chips_per_worker = self.model_parallel
-            elif self.num_chips_per_worker % self.model_parallel != 0:
-                raise ValueError(
-                    f"num_chips_per_worker={self.num_chips_per_worker} is not a "
-                    f"multiple of model_parallel={self.model_parallel}"
-                )
-        else:
-            self.model_parallel = 1
+        self.model_parallel = self.model_parallel or 1
+        self.sequence_parallel = self.sequence_parallel or 1
+        if self.model_parallel < 1:
+            raise ValueError("model_parallel must be >= 1")
+        if self.sequence_parallel < 1:
+            raise ValueError("sequence_parallel must be >= 1")
+        # a worker's chips must cover the PRODUCT of its in-worker axes —
+        # validating against each degree separately would silently accept
+        # model_parallel=2, sequence_parallel=2 on 2 chips
+        axes = self.model_parallel * self.sequence_parallel
+        if self.num_chips_per_worker == 1:
+            self.num_chips_per_worker = axes
+        elif self.num_chips_per_worker % axes != 0:
+            raise ValueError(
+                f"num_chips_per_worker={self.num_chips_per_worker} is not a "
+                f"multiple of model_parallel x sequence_parallel = {axes}"
+            )
 
     @property
     def total_chips(self) -> int:

@@ -1,0 +1,182 @@
+"""LMTrainer — long-context causal-LM training through the Trainer API.
+
+Sequence parallelism as a CONFIG CHANGE, not a bespoke script:
+``ScalingConfig(num_workers=dp, sequence_parallel=sp)`` builds a
+``(data, sequence)`` mesh and runs the shard_map SP step
+(parallel/sequence_parallel.py — ring attention over the sequence axis,
+chunked lm-head CE, replicated params with a single psum).  The reference
+caps every sequence at 512 tokens (utils.py:23-28); this trainer's context
+scales with the ``sequence`` axis, wrapped in the same fit() → Result →
+Checkpoint contract as T5Trainer so Tune / BatchPredictor / resume compose
+unchanged.
+
+Datasets: rows with an ``input_ids`` column (fixed-length token lists).
+Targets are the global next-token shift, computed BEFORE sequence sharding.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .checkpoint import Checkpoint
+from .t5_trainer import TrainingArguments, _make_optimizer, collate
+from .trainer import BaseTrainer
+
+
+def lm_train_loop(config: Dict[str, Any]) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_air.models.lm import LMConfig
+    from tpu_air.parallel.sequence_parallel import (
+        make_sp_mesh,
+        make_sp_train_step,
+        shard_batch,
+        sp_local_loss,
+    )
+    from tpu_air.parallel.shardmap_compat import shard_map_unchecked
+    from tpu_air.train import session
+
+    args: TrainingArguments = config.get("training_args") or TrainingArguments()
+    for k in ("learning_rate", "num_train_epochs", "weight_decay"):
+        if k in config:
+            setattr(args, k, config[k])
+
+    model_config: LMConfig = config["model_config"]
+    preprocessor = config.get("_preprocessor")
+
+    sc = config.get("_scaling_config")
+    sp = getattr(sc, "sequence_parallel", None) or 1
+    mesh = make_sp_mesh(sp=sp)
+    dp = mesh.shape["data"]
+    ndev = dp * sp
+    pad = model_config.pad_token_id
+
+    train_ds = session.get_dataset_shard("train")
+    if train_ds is None:
+        raise ValueError("LMTrainer requires a 'train' dataset")
+    eval_ds = session.get_dataset_shard("evaluation") or session.get_dataset_shard("eval")
+
+    tx_total = train_ds.count()
+    global_bs = args.per_device_train_batch_size * dp
+    steps_per_epoch = max(1, tx_total // global_bs)
+    if args.max_steps_per_epoch:
+        steps_per_epoch = min(steps_per_epoch, args.max_steps_per_epoch)
+    tx = _make_optimizer(args, steps_per_epoch * args.num_train_epochs)
+
+    step, model = make_sp_train_step(model_config, mesh, tx)
+
+    # eval: the SAME local-loss recipe the train step differentiates
+    # (sp_local_loss — single source of truth), no update, psum'd sums
+    def eval_local(params, input_ids, targets):
+        s, c = sp_local_loss(model, params, input_ids, targets)
+        return (jax.lax.psum(s, ("data", "sequence")),
+                jax.lax.psum(c, ("data", "sequence")))
+
+    repl, dsh = P(), P("data", "sequence")
+    eval_step = jax.jit(shard_map_unchecked(
+        eval_local, mesh=mesh, in_specs=(repl, dsh, dsh), out_specs=(repl, repl)
+    ))
+
+    resume_dir = config.get("resume_from_checkpoint")
+    if resume_dir:
+        params = Checkpoint.from_directory(resume_dir).get_params()
+        params = jax.device_put(params, NamedSharding(mesh, P()))
+    else:
+        from tpu_air.parallel.sequence_parallel import init_sp_params
+
+        params = init_sp_params(model_config, mesh, seed=args.seed)
+    opt_state = jax.device_put(tx.init(params), NamedSharding(mesh, P()))
+
+    def batches(ds, bs, drop_last=True):
+        for df in ds.iter_batches(batch_size=bs, batch_format="pandas",
+                                  drop_last=drop_last):
+            ids = collate(df, ["input_ids"])["input_ids"]
+            # global next-token shift BEFORE sequence sharding, on host
+            # (shift_targets semantics, without a device round-trip)
+            tgt = np.concatenate(
+                [ids[:, 1:], np.full((ids.shape[0], 1), pad, ids.dtype)], axis=1
+            )
+            if len(ids) % bs:
+                # partial eval batch: pad with all-pad rows — their targets
+                # are fully masked, so they contribute (0, 0) to the sums
+                need = bs - len(ids) % bs
+                ids = np.concatenate(
+                    [ids, np.full((need, ids.shape[1]), pad, ids.dtype)]
+                )
+                tgt = np.concatenate(
+                    [tgt, np.full((need, tgt.shape[1]), pad, tgt.dtype)]
+                )
+            yield shard_batch(mesh, jnp.asarray(ids), jnp.asarray(tgt))
+
+    for epoch in range(int(args.num_train_epochs)):
+        t0 = time.time()
+        losses, tokens, nsteps = [], 0, 0
+        for ids, tgt in batches(train_ds, global_bs):
+            params, opt_state, loss = step(params, opt_state, ids, tgt)
+            losses.append(float(loss))
+            tokens += ids.shape[0] * ids.shape[1]
+            nsteps += 1
+            if args.max_steps_per_epoch and nsteps >= args.max_steps_per_epoch:
+                break
+        dt = time.time() - t0
+        metrics: Dict[str, Any] = {
+            "epoch": epoch + 1,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "steps": nsteps,
+            "train_tokens_per_sec": tokens / dt if dt > 0 else 0.0,
+            "train_tokens_per_sec_per_chip": tokens / dt / ndev if dt > 0 else 0.0,
+            "mesh_data": dp,
+            "mesh_sequence": sp,
+        }
+        if eval_ds is not None and args.evaluation_strategy == "epoch":
+            tot, cnt = 0.0, 0
+            ebs = args.per_device_eval_batch_size * dp
+            for ids, tgt in batches(eval_ds, ebs, drop_last=False):
+                s, c = eval_step(params, ids, tgt)
+                tot += float(s)
+                cnt += int(c)
+            if cnt:
+                metrics["eval_loss"] = tot / cnt
+        ckpt = None
+        if args.save_strategy == "epoch":
+            ckpt = Checkpoint.from_model(
+                model_config=model_config,
+                params=params,
+                preprocessor=preprocessor,
+                metrics=metrics,
+            )
+        session.report(metrics, checkpoint=ckpt)
+
+
+class LMTrainer(BaseTrainer):
+    """Long-context causal-LM trainer: SP is a ScalingConfig field."""
+
+    _name_prefix = "LMTrainer"
+
+    def __init__(
+        self,
+        *,
+        model_config,
+        training_args: Optional[TrainingArguments] = None,
+        trainer_init_config: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.model_config = model_config
+        self.training_args = training_args or TrainingArguments()
+        self.trainer_init_config = trainer_init_config or {}
+
+    def _training_fn(self):
+        return lm_train_loop
+
+    def _train_loop_config(self) -> Dict[str, Any]:
+        return {
+            "model_config": self.model_config,
+            "training_args": self.training_args,
+            **self.trainer_init_config,
+        }
