@@ -358,3 +358,43 @@ def test_user_training_iteration_does_not_stall_stream(air):
     assert grid.num_errors == 0
     # scheduler saw every streamed report despite user-supplied counters
     assert sched.seen == [100, 200, 300]
+
+
+# -- long-context LM sweep over sub-mesh leases -------------------------------
+
+def test_tuner_over_lm_trainer_sequence_parallel(air):
+    """Trial-parallel HPO composes with the long-context trainer: each trial
+    leases a dp x sp sub-mesh (ScalingConfig(sequence_parallel=2)) and runs
+    the ring-attention SP step through LMTrainer."""
+    from tpu_air.train import LMTrainer
+    from tpu_air.models.lm import LMConfig
+
+    rng = np.random.RandomState(0)
+    L = 32
+    rows = [{"input_ids": (2 + (np.arange(L) + rng.randint(13)) % 13)
+             .astype(np.int32).tolist()} for _ in range(16)]
+    ds = tad.from_items(rows)
+    trainer = LMTrainer(
+        model_config=LMConfig.tiny(),
+        training_args=TrainingArguments(
+            per_device_train_batch_size=2, num_train_epochs=1,
+            max_steps_per_epoch=2, weight_decay=0.0,
+        ),
+        scaling_config=ScalingConfig(num_workers=1, sequence_parallel=2),
+        datasets={"train": ds, "evaluation": ds.limit(4)},
+        run_config=RunConfig(checkpoint_config=CheckpointConfig(
+            num_to_keep=1, checkpoint_score_attribute="eval_loss",
+            checkpoint_score_order="min")),
+    )
+    grid = tune.Tuner(
+        trainer,
+        param_space={"trainer_init_config": {
+            "learning_rate": tune.choice([1e-3, 1e-5]),
+        }},
+        tune_config=tune.TuneConfig(metric="eval_loss", mode="min",
+                                    num_samples=2, seed=0),
+    ).fit()
+    assert len(grid) == 2 and grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.checkpoint is not None
+    assert best.metrics["mesh_sequence"] == 2
