@@ -1,4 +1,5 @@
 from .config import LMConfig
+from .generate import generate, make_lm_generate_fn
 from .modeling import (
     CausalLM,
     head_weight,
@@ -9,6 +10,8 @@ from .modeling import (
 
 __all__ = [
     "LMConfig",
+    "generate",
+    "make_lm_generate_fn",
     "CausalLM",
     "head_weight",
     "lm_chunked_loss_with_targets",

@@ -1,0 +1,132 @@
+"""Autoregressive generation for the causal LM family, under jit.
+
+Same contract as the T5 generate (models/t5/generate.py): a fixed-shape
+``lax.scan`` decode loop over a pre-allocated KV cache — prefill processes
+the whole prompt in one cached call, then one cached call per new token.
+Greedy by default; temperature/top-k via the shared sampler
+(models/sampling.py).  TPU-minded details:
+
+* the cache is RIGHT-SIZED to ``L_prompt + max_new_tokens`` (a decode-time
+  config override — cache length is static per compiled shape), not to the
+  model's ``max_seq_len``, so per-token attention cost is O(L_prompt + t);
+* prefill computes only the LAST position's logits via ``return_hidden`` +
+  ``head_weight`` — the (B, L, V) prompt logits tensor (the long-context
+  memory cliff lm_chunked_loss_with_targets exists for) never materializes;
+* the scan emits the token it computes (no discarded final forward).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_air.models.sampling import sample_token
+
+from .config import LMConfig
+from .modeling import CausalLM, head_weight
+
+
+def init_cache(model: CausalLM, batch_size: int):
+    """Zero cache with the right structure, via eval_shape (free).  Cache
+    length comes from ``model.config.max_seq_len`` — generate passes a
+    decode model whose config is right-sized to prompt + budget."""
+
+    def _init():
+        return model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch_size, 1), jnp.int32),
+            decode=True,
+        )
+
+    shapes = jax.eval_shape(_init)["cache"]
+    return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def make_lm_generate_fn(model: CausalLM, max_new_tokens: int,
+                        do_sample: bool = False, temperature: float = 1.0,
+                        top_k: int = 0, eos_token_id: Optional[int] = None):
+    """Build a jitted ``fn(params, input_ids, rng) -> (B, max_new_tokens)``.
+
+    ``input_ids``: (B, L_prompt) un-padded prompts (fixed shape per compile).
+    After ``eos_token_id`` is emitted a row keeps emitting pad."""
+    cfg = model.config
+    pad = cfg.pad_token_id
+
+    def pick(logits, rng):
+        return sample_token(logits, rng, do_sample, temperature, top_k)
+
+    @jax.jit
+    def generate(params, input_ids, rng):
+        b, lp = input_ids.shape
+        total = lp + max_new_tokens
+        if total > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {lp} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_seq_len {cfg.max_seq_len}"
+            )
+        # decode model with a right-sized cache (lp/max_new are static at
+        # trace time; params are unaffected by max_seq_len)
+        dmodel = CausalLM(LMConfig.from_dict(
+            {**cfg.to_dict(), "max_seq_len": total}
+        ))
+        cache = init_cache(dmodel, b)
+        positions = jnp.broadcast_to(jnp.arange(lp, dtype=jnp.int32), (b, lp))
+        # prefill: hidden states only — head applied to the LAST position,
+        # never to the (B, L, V) prompt logits
+        hidden, vars_ = dmodel.apply(
+            {"params": params, "cache": cache}, input_ids, positions,
+            decode=True, return_hidden=True, mutable=["cache"],
+        )
+        head_w = head_weight(params, cfg).astype(jnp.float32)
+        rng, sub = jax.random.split(rng)
+        tok = pick(hidden[:, -1].astype(jnp.float32) @ head_w, sub)
+        done = (tok == eos_token_id) if eos_token_id is not None else None
+
+        def step(carry, _):
+            cache, tok, pos, rng, done = carry
+            hidden, vars_ = dmodel.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                jnp.full((b, 1), pos, jnp.int32), decode=True,
+                return_hidden=True, mutable=["cache"],
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = pick(hidden[:, -1].astype(jnp.float32) @ head_w, sub)
+            if done is not None:
+                nxt = jnp.where(done, pad, nxt)
+                done = done | (nxt == eos_token_id)
+            return (vars_["cache"], nxt, pos + 1, rng, done), nxt
+
+        # the prefill already produced token 0; the scan computes (and
+        # emits) the remaining max_new_tokens - 1 — no discarded forward
+        (_, _, _, _, _), toks = jax.lax.scan(
+            step, (vars_["cache"], tok, jnp.int32(lp), rng, done), None,
+            length=max_new_tokens - 1,
+        )
+        return jnp.concatenate([tok[:, None], toks.T], axis=1)
+
+    return generate
+
+
+_GEN_CACHE: Dict[Tuple, Any] = {}
+_GEN_CACHE_MAX = 16
+
+
+def generate(model: CausalLM, params, input_ids, max_new_tokens: int = 64,
+             do_sample: bool = False, temperature: float = 1.0, top_k: int = 0,
+             eos_token_id: Optional[int] = None, rng=None):
+    """Convenience wrapper caching compiled generate fns per config (the
+    t5/generate.py pattern — repeated same-shape calls never retrace)."""
+    cfg_key = tuple(sorted(model.config.to_dict().items()))
+    key = (cfg_key, max_new_tokens, do_sample, temperature, top_k, eos_token_id)
+    if key not in _GEN_CACHE:
+        if len(_GEN_CACHE) >= _GEN_CACHE_MAX:
+            _GEN_CACHE.pop(next(iter(_GEN_CACHE)))
+        _GEN_CACHE[key] = make_lm_generate_fn(
+            model, max_new_tokens, do_sample, temperature, top_k, eos_token_id
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _GEN_CACHE[key](params, jnp.asarray(input_ids, jnp.int32), rng)

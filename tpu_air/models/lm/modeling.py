@@ -70,7 +70,7 @@ class CausalSelfAttention(nn.Module):
     config: LMConfig
 
     @nn.compact
-    def __call__(self, x: Array, positions: Array) -> Array:
+    def __call__(self, x: Array, positions: Array, decode: bool = False) -> Array:
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         b, l, _ = x.shape
@@ -86,6 +86,35 @@ class CausalSelfAttention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         scale = 1.0 / (d ** 0.5)
+
+        if decode:
+            # KV-cache path (autoregressive generate, SURVEY.md §7
+            # hard-part 2): keys/values land at the running cache index via
+            # dynamic_update_slice; attention is dense over the cache with
+            # the query offset at the index, so the SAME call handles both
+            # the multi-token prefill and 1-token decode steps.  Cached k is
+            # already RoPE'd (positions are global — the caller derives them
+            # from the cache index).
+            max_len = cfg.max_seq_len
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros((b, h, max_len, d), dtype))
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros((b, h, max_len, d), dtype))
+            idx = self.variable(
+                "cache", "cache_index", lambda: jnp.array(0, jnp.int32))
+            i = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(dtype), (0, 0, i, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(dtype), (0, 0, i, 0))
+            idx.value = i + l
+            # future cache slots are zeros but kj > qi masks them out
+            o = _dense_causal_attention(q, ck.value, cv.value, scale,
+                                        q_offset=i)
+            o = o.transpose(0, 2, 1, 3).reshape(b, l, h * d)
+            return proj("o", cfg.d_model)(o)
 
         if cfg.attention == "ring":
             if cfg.sequence_axis is None:
@@ -133,12 +162,14 @@ class Block(nn.Module):
     config: LMConfig
 
     @nn.compact
-    def __call__(self, x: Array, positions: Array, deterministic: bool = True) -> Array:
+    def __call__(self, x: Array, positions: Array, deterministic: bool = True,
+                 decode: bool = False) -> Array:
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         drop = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)
         x = x + drop(CausalSelfAttention(cfg, name="attn")(
-            RMSNorm(cfg.rmsnorm_eps, dtype, name="attn_norm")(x), positions
+            RMSNorm(cfg.rmsnorm_eps, dtype, name="attn_norm")(x), positions,
+            decode=decode,
         ))
         x = x + drop(SwiGLU(cfg, name="mlp")(
             RMSNorm(cfg.rmsnorm_eps, dtype, name="mlp_norm")(x)
@@ -158,7 +189,8 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids: Array, positions: Optional[Array] = None,
-                 deterministic: bool = True, return_hidden: bool = False) -> Array:
+                 deterministic: bool = True, return_hidden: bool = False,
+                 decode: bool = False) -> Array:
         cfg = self.config
         b, l = input_ids.shape
         if l > cfg.max_seq_len:
@@ -174,7 +206,8 @@ class CausalLM(nn.Module):
         )
         x = embed[input_ids].astype(dtype)
         for i in range(cfg.n_layers):
-            x = Block(cfg, name=f"layer_{i}")(x, positions, deterministic)
+            x = Block(cfg, name=f"layer_{i}")(x, positions, deterministic,
+                                              decode=decode)
         x = RMSNorm(cfg.rmsnorm_eps, dtype, name="final_norm")(x)
         if return_hidden:
             # pre-head hidden states: pair with head_weight() +

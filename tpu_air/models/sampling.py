@@ -1,0 +1,20 @@
+"""Shared token sampler for the autoregressive generate loops (T5 + LM)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token(logits, rng, do_sample: bool, temperature: float, top_k: int):
+    """Greedy argmax, or temperature/top-k categorical sampling.
+
+    ``top_k`` uses ``lax.top_k`` (partial selection), not a full vocab sort —
+    this runs once per decoded token."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -40,14 +40,7 @@ def init_cache(model, batch_size: int, max_decode_len: int, enc_hidden, enc_mask
     return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
-def _sample_token(logits, rng, do_sample: bool, temperature: float, top_k: int):
-    if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / jnp.maximum(temperature, 1e-6)
-    if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -1e9, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+from tpu_air.models.sampling import sample_token as _sample_token  # noqa: E402
 
 
 def make_generate_fn(
