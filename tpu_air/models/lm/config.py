@@ -36,6 +36,7 @@ class LMConfig:
     block_q: Optional[int] = None
     block_k: Optional[int] = None
     pad_token_id: int = 0
+    eos_token_id: Optional[int] = None  # None: generation never early-stops
 
     def __post_init__(self):
         if self.head_dim is None:

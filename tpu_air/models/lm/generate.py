@@ -17,7 +17,6 @@ Greedy by default; temperature/top-k via the shared sampler
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax

@@ -11,6 +11,7 @@ from tpu_air.predict.predictors import (
     JaxPredictor,
     SemanticSegmentationPredictor,
     SklearnPredictor,
+    LMGenerativePredictor,
     T5GenerativePredictor,
 )
 
@@ -21,5 +22,6 @@ __all__ = [
     "JaxPredictor",
     "SemanticSegmentationPredictor",
     "SklearnPredictor",
+    "LMGenerativePredictor",
     "T5GenerativePredictor",
 ]

@@ -96,6 +96,97 @@ class T5GenerativePredictor(Predictor):
         return pd.DataFrame({"generated_output": texts})
 
 
+class LMGenerativePredictor(Predictor):
+    """Batched text generation from a causal-LM checkpoint (LMTrainer
+    output) — the decoder-only sibling of :class:`T5GenerativePredictor`,
+    so LM checkpoints compose with BatchPredictor / serve unchanged."""
+
+    def __init__(self, model, params, tokenizer=None, preprocessor=None):
+        super().__init__(preprocessor)
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint,
+        *,
+        tokenizer=None,
+        dtype: Optional[str] = None,
+        **_: Any,
+    ) -> "LMGenerativePredictor":
+        model, params = checkpoint.get_model(dtype=dtype)
+        if dtype:
+            import jax
+            import jax.numpy as jnp
+
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.dtype(dtype)) if hasattr(x, "astype") else x,
+                params,
+            )
+        tok = tokenizer
+        if tok is None or isinstance(tok, type):
+            try:
+                tok = checkpoint.get_tokenizer(tok if isinstance(tok, type) else None)
+            except FileNotFoundError:
+                # token-id corpora (LMTrainer's input) train without a
+                # tokenizer; generation then returns id strings
+                tok = None
+        return cls(model, params, tok, checkpoint.get_preprocessor())
+
+    def _predict_numpy(
+        self,
+        data: Dict[str, np.ndarray],
+        feature_columns: Optional[List[str]] = None,
+        max_new_tokens: int = 64,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        seed: int = 0,
+        **_: Any,
+    ) -> pd.DataFrame:
+        import jax
+
+        from tpu_air.models.lm import generate
+
+        if feature_columns:
+            data = {k: v for k, v in data.items() if k in feature_columns}
+        try:
+            input_ids = np.asarray(
+                np.stack([np.asarray(r) for r in data["input_ids"]])
+            )
+        except ValueError as e:
+            raise ValueError(
+                "LMGenerativePredictor needs EQUAL-LENGTH prompts per batch "
+                "(the decode cache is positional): bucket rows by length "
+                f"before predict ({e})"
+            ) from None
+        if (input_ids == self.model.config.pad_token_id).any():
+            # padded prompts would feed pad tokens as real context and
+            # sample the first token from a pad position's logits
+            raise ValueError(
+                "LMGenerativePredictor prompts must be un-padded; strip pad "
+                "tokens and bucket rows to equal lengths"
+            )
+        # vary sampling noise across batches deterministically: fold a
+        # per-predictor call counter into the seed
+        self._calls = getattr(self, "_calls", 0) + 1
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._calls)
+        toks = np.asarray(generate(
+            self.model, self.params, input_ids,
+            max_new_tokens=max_new_tokens, do_sample=do_sample,
+            temperature=temperature, top_k=top_k,
+            eos_token_id=getattr(self.model.config, "eos_token_id", None),
+            rng=rng,
+        ))
+        if self.tokenizer is not None:
+            texts = self.tokenizer.batch_decode(toks, skip_special_tokens=True)
+        else:
+            texts = [" ".join(map(str, row)) for row in toks]
+        return pd.DataFrame({"generated_output": texts})
+
+
 class JaxPredictor(Predictor):
     """Generic forward-pass predictor: ``apply_fn(params, **features)``."""
 
