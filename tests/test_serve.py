@@ -225,3 +225,32 @@ def test_predictor_deployment_over_checkpoint(air):
     assert [r["predictions"] for r in out] == [3.0, 7.0]
     st = serve.status()
     assert st["deployments"]["/linear"]["num_replicas"] == 2
+
+
+def test_serve_lm_generative_checkpoint(air):
+    """An LMTrainer-style checkpoint serves generation over HTTP through
+    PredictorDeployment — the W8 serve arc on the LM family."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_air.models.lm import CausalLM, LMConfig
+    from tpu_air.predict import LMGenerativePredictor
+    from tpu_air.train import Checkpoint
+
+    cfg = LMConfig.tiny()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+
+    serve.run(
+        PredictorDeployment.options(
+            name="LMService", num_replicas=1, route_prefix="/lm"
+        ).bind(LMGenerativePredictor, ckpt,
+               predict_kwargs={"max_new_tokens": 4}),
+        port=PORT,
+    )
+    status, out = _post("/lm", [{"input_ids": [5, 6, 7, 8]},
+                                {"input_ids": [9, 10, 11, 12]}])
+    assert status == 200, out
+    assert len(out) == 2 and all(r["generated_output"] for r in out)
