@@ -309,6 +309,51 @@ def test_self_contained_values_release_pin_immediately(tmp_path):
     s.destroy()
 
 
+def test_derived_object_outliving_container_keeps_pin(tmp_path):
+    """The pin must be tied to the out-of-band BUFFERS, not the top-level
+    value: a Series extracted from a DataFrame (or an array pulled out of a
+    dict) outlives its container while still referencing arena bytes.
+    Regression for the round-3 advisor finding (object_store._get_pinned)."""
+    import gc
+
+    import pandas as pd
+
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    Arena(os.path.join(root, "__arena__"), create=True,
+          capacity=1 << 21, slots=1 << 10)
+    s = ObjectStore(root)
+
+    # case 1: array extracted from a dict container
+    arr = np.arange(30_000, dtype=np.uint32)
+    ref = s.put({"payload": arr, "meta": "x"})
+    val = s.get(ref.id)
+    inner = val["payload"]          # derived: shares the arena bytes
+    del val
+    gc.collect()                    # container dies; pin must survive
+    s.delete(ref.id)
+    for i in range(20):             # block-reuse pressure
+        s.put(np.full(40_000, i, dtype=np.uint8))
+    np.testing.assert_array_equal(inner, arr)
+    del inner
+    gc.collect()
+
+    # case 2: Series extracted from a DataFrame
+    df = pd.DataFrame({"a": np.arange(20_000, dtype=np.int64),
+                       "b": np.ones(20_000)})
+    ref2 = s.put(df)
+    got = s.get(ref2.id)
+    series = got["a"]               # derived view of the block manager
+    del got
+    gc.collect()
+    s.delete(ref2.id)
+    for i in range(20):
+        s.put(np.full(40_000, i, dtype=np.uint8))
+    np.testing.assert_array_equal(series.to_numpy(),
+                                  np.arange(20_000, dtype=np.int64))
+    s.destroy()
+
+
 def test_reput_same_id_while_old_generation_zombie(tmp_path):
     """Pin disambiguation: unpinning an old generation must not touch a
     re-put of the same id."""

@@ -320,3 +320,56 @@ def test_distributed_gbdt_matches_single_process(air):
     pred = GBDTPredictor.from_checkpoint(r4.checkpoint)
     out = pred.predict(valid_ds.limit(8).to_pandas().drop(columns=["label"]))
     assert len(out) == 8
+
+
+def test_scaling_config_rejects_zero_parallel_degrees():
+    """An explicit 0 must raise, not silently coerce to 1 and train
+    replicated (round-3 advisor finding, config.py)."""
+    with pytest.raises(ValueError):
+        ScalingConfig(num_workers=2, model_parallel=0)
+    with pytest.raises(ValueError):
+        ScalingConfig(num_workers=2, sequence_parallel=0)
+    # None still defaults to 1
+    assert ScalingConfig(num_workers=2).model_parallel == 1
+
+
+def test_spill_dir_owner_marker_protects_custom_roots(tmp_path):
+    """The stale-session sweeper must check the .owner marker path for
+    liveness — a live session rooted in a CUSTOM base dir must not have its
+    spill dir reaped (round-3 advisor finding, runtime.py)."""
+    import os
+    import time
+
+    from tpu_air.core.object_store import ObjectStore
+    from tpu_air.core.runtime import _sweep_stale_sessions
+
+    custom_base = tmp_path / "custombase"
+    custom_base.mkdir()
+    root = custom_base / "tpu_air-livecustom"
+    store = ObjectStore(str(root), create=True)
+    store._spill_dir = str(tmp_path / "var_tmp" / "tpu_air-spill-tpu_air-livecustom")
+    store._ensure_spill_dir()
+    spilled = os.path.join(store._spill_dir, "someobject")
+    with open(spilled, "w") as f:
+        f.write("x")
+    # age everything past the stale threshold
+    old = time.time() - 3 * 3600
+    os.utime(store._spill_dir, (old, old))
+    os.utime(spilled, (old, old))
+
+    real_var_tmp = str(tmp_path / "var_tmp")
+    _sweep_stale_sessions(str(tmp_path / "shm"), spill_base=real_var_tmp)
+    # live owner root exists → spill dir must survive
+    assert os.path.exists(spilled), "sweeper reaped a live custom-root session"
+
+    # now kill the owner: dir becomes reapable
+    store.destroy()
+    os.makedirs(store._spill_dir, exist_ok=True)
+    with open(os.path.join(store._spill_dir, ".owner"), "w") as f:
+        f.write(str(root))
+    with open(spilled, "w") as f:
+        f.write("x")
+    os.utime(store._spill_dir, (old, old))
+    os.utime(spilled, (old, old))
+    _sweep_stale_sessions(str(tmp_path / "shm"), spill_base=real_var_tmp)
+    assert not os.path.exists(store._spill_dir), "dead session spill dir not reaped"

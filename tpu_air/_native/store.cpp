@@ -299,14 +299,48 @@ int64_t arena_alloc(int h, const uint8_t* id, uint64_t size) {
   // permanently exhaust the fixed-capacity index).  Zombies are NOT
   // reusable — their block is still pinned by readers.
   uint32_t tomb_idx = UINT32_MAX;
-  auto install = [&](Slot& s) -> int64_t {
+  auto install = [&](Slot& s) {
     std::memcpy(s.id, id, kIdBytes);
     s.offset = off;
     s.size = size;
     s.block = block;
     s.pins.store(0, std::memory_order_relaxed);
-    // release-publish the identity; only now may probers read s.id
-    s.state.store(kClaimed, std::memory_order_release);
+    // publish the identity; only now may probers read s.id.  seq_cst (not
+    // just release) so the post-install verify scan below forms the SB
+    // pattern with a racing writer — see `finish`.
+    s.state.store(kClaimed, std::memory_order_seq_cst);
+  };
+  // Post-install duplicate verify.  Tombstone recycling makes the pre-claim
+  // duplicate scan insufficient on its own: writer A can install id X into an
+  // early tombstone AFTER writer B's scan probed past it while B claims the
+  // end-of-chain EMPTY slot — two live slots for one id.  So after winning a
+  // CAS each writer re-scans the chain (SB pattern: the claim is a seq_cst
+  // store and these are seq_cst loads — of two racing writers at least one
+  // is guaranteed to see the other's claim).  A writer that sees a rival
+  // demotes ITS OWN slot and reports duplicate; worst case both yield and the
+  // caller's file-store fallback keeps the object durable.
+  auto finish = [&](uint32_t my_idx) -> int64_t {
+    Slot& mine = a.slots[my_idx];
+    uint32_t vidx = (uint32_t)(fnv1a(id)) & mask;
+    for (uint32_t probe = 0; probe < hdr->num_slots; ++probe, vidx = (vidx + 1) & mask) {
+      if (vidx == my_idx) continue;
+      Slot& v = a.slots[vidx];
+      uint32_t st = v.state.load(std::memory_order_seq_cst);
+      if (st == kEmpty) break;
+      for (int spin = 0; st == kReserved && spin < 100000; ++spin) {
+        ::sched_yield();
+        st = v.state.load(std::memory_order_acquire);
+      }
+      if ((st == kClaimed || st == kSealed) && id_eq(v.id, id)) {
+        // CAS, not a plain store: a concurrent delete may have tombstoned
+        // our claimed slot already and an alloc recycled it for another id.
+        uint32_t c = kClaimed;
+        mine.state.compare_exchange_strong(c, kTombstone,
+                                           std::memory_order_acq_rel);
+        rollback();
+        return -3;
+      }
+    }
     return (int64_t)(hdr->data_start + off);
   };
   for (uint32_t probe = 0; probe < hdr->num_slots; ++probe, idx = (idx + 1) & mask) {
@@ -318,14 +352,18 @@ int64_t arena_alloc(int h, const uint8_t* id, uint64_t size) {
         Slot& t = a.slots[tomb_idx];
         uint32_t expected = kTombstone;
         if (t.state.compare_exchange_strong(expected, kReserved,
-                                            std::memory_order_acq_rel))
-          return install(t);
+                                            std::memory_order_acq_rel)) {
+          install(t);
+          return finish(tomb_idx);
+        }
         // lost the tombstone to a concurrent alloc — fall through to kEmpty
       }
       uint32_t expected = kEmpty;
       if (s.state.compare_exchange_strong(expected, kReserved,
-                                          std::memory_order_acq_rel))
-        return install(s);
+                                          std::memory_order_acq_rel)) {
+        install(s);
+        return finish(idx);
+      }
       st = s.state.load(std::memory_order_acquire);  // lost race; re-read
     }
     // Identity unknown while RESERVED (owner mid-memcpy); wait, because if
@@ -352,8 +390,10 @@ int64_t arena_alloc(int h, const uint8_t* id, uint64_t size) {
     Slot& t = a.slots[tomb_idx];
     uint32_t expected = kTombstone;
     if (t.state.compare_exchange_strong(expected, kReserved,
-                                        std::memory_order_acq_rel))
-      return install(t);
+                                        std::memory_order_acq_rel)) {
+      install(t);
+      return finish(tomb_idx);
+    }
   }
   rollback();
   return -2;

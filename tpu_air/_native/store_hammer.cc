@@ -212,6 +212,63 @@ int run_threads(const char* path, int nwriters, int nreaders, int nobjs) {
   return failures.load() ? 1 : 0;
 }
 
+// Duplicate-id race over tombstone churn: every round K threads race
+// arena_alloc on the SAME id whose previous generation was just deleted
+// (its tombstone sits in the probe chain, so one racer can recycle it while
+// another claims the end-of-chain EMPTY slot).  Invariant: one
+// arena_delete makes the id unfindable — a lookup hit after the delete
+// means TWO sealed slots were installed for one id.
+int run_dup(const char* path, int nthreads, int iters) {
+  int h = arena_open(path);
+  if (h < 0) return 2;
+  uint64_t len = 0;
+  uint8_t* base = map_file(path, &len);
+  if (!base) return 2;
+
+  uint8_t id[kIdBytes];
+  std::memset(id, 0, kIdBytes);
+  std::snprintf(reinterpret_cast<char*>(id), kIdBytes, "dup_target");
+  int failures = 0, missed_rounds = 0;
+  for (int it = 0; it < iters && !failures; ++it) {
+    std::atomic<int> go{0}, sealed{0};
+    std::vector<std::thread> ts;
+    for (int t = 0; t < nthreads; ++t) {
+      ts.emplace_back([&, t] {
+        while (!go.load(std::memory_order_acquire)) ::sched_yield();
+        int64_t off = arena_alloc(h, id, kObjSize);
+        if (off >= 0) {
+          for (uint64_t i = 0; i < kObjSize; i += 257)
+            base[(uint64_t)off + i] = pattern_byte(t, it, i);
+          if (arena_seal(h, id) == 0) sealed.fetch_add(1);
+        }
+      });
+    }
+    go.store(1, std::memory_order_release);
+    for (auto& t : ts) t.join();
+    if (sealed.load() > 1) {
+      std::fprintf(stderr, "dup: %d sealed generations in round %d\n",
+                   sealed.load(), it);
+      ++failures;
+    }
+    if (sealed.load() == 0) ++missed_rounds;  // all yielded — allowed (file
+                                              // fallback), count only
+    arena_delete(h, id);
+    uint64_t off = 0, size = 0;
+    if (arena_lookup(h, id, &off, &size) == 1) {
+      std::fprintf(stderr,
+                   "dup: id still findable after delete in round %d — "
+                   "a duplicate slot survived\n", it);
+      ++failures;
+    }
+  }
+  if (missed_rounds)
+    std::printf("dup: %d/%d rounds all-yield (fallback path)\n", missed_rounds,
+                iters);
+  ::munmap(base, len);
+  arena_close(h);
+  return failures ? 1 : 0;
+}
+
 int run_procs(const char* path, int nwriters, int nreaders, int nobjs) {
   std::vector<pid_t> pids;
   for (int w = 0; w < nwriters; ++w) {
@@ -250,8 +307,10 @@ int run_procs(const char* path, int nwriters, int nreaders, int nobjs) {
 int main(int argc, char** argv) {
   if (argc != 6) {
     std::fprintf(stderr,
-                 "usage: %s threads|procs <arena_path> <writers> <readers> "
-                 "<objs_per_writer>\n",
+                 "usage: %s threads|procs|dup <arena_path> <writers> <readers> "
+                 "<objs_per_writer>\n"
+                 "  dup mode: <writers> = racing threads, <readers> ignored, "
+                 "<objs_per_writer> = rounds\n",
                  argv[0]);
     return 2;
   }
@@ -268,6 +327,7 @@ int main(int argc, char** argv) {
     return 2;
   }
   int rc = mode == "threads" ? run_threads(path, nwriters, nreaders, nobjs)
+           : mode == "dup"   ? run_dup(path, nwriters, nobjs)
                              : run_procs(path, nwriters, nreaders, nobjs);
   ::unlink(path);
   if (rc == 0) std::printf("hammer %s: OK\n", mode.c_str());

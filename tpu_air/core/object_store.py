@@ -108,6 +108,21 @@ class ObjectStore:
     def _spill_path(self, object_id: str) -> str:
         return os.path.join(self._spill_dir, object_id)
 
+    def _ensure_spill_dir(self) -> None:
+        """Create the spill dir with an ``.owner`` marker naming the store
+        root's absolute path, so the stale-session sweeper can check THAT
+        path for liveness instead of guessing at default base dirs."""
+        os.makedirs(self._spill_dir, exist_ok=True)
+        marker = os.path.join(self._spill_dir, ".owner")
+        if not os.path.exists(marker):
+            tmp = f"{marker}.tmp-{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    f.write(os.path.abspath(self.root))
+                os.rename(tmp, marker)
+            except OSError:
+                pass
+
     # -- spilling ----------------------------------------------------------
     def _scan_files(self):
         """(mtime, size, name) for sealed file objects under the root."""
@@ -154,13 +169,13 @@ class ObjectStore:
         if need > self._file_budget:
             # spilling residents can't help — don't evict the hot set for an
             # object that is going to disk regardless
-            os.makedirs(self._spill_dir, exist_ok=True)
+            self._ensure_spill_dir()
             return False
         files = self._scan_files()
         usage = sum(s for _, s, _ in files)
         if usage + need <= self._file_budget:
             return True
-        os.makedirs(self._spill_dir, exist_ok=True)
+        self._ensure_spill_dir()
         for _, size, name in sorted(files):
             if usage + need <= self._file_budget:
                 break
@@ -202,7 +217,7 @@ class ObjectStore:
             if not self._make_room(need):
                 # even after spilling everything the new object busts the
                 # tmpfs budget — write it straight to disk
-                os.makedirs(self._spill_dir, exist_ok=True)
+                self._ensure_spill_dir()
                 target_root = self._spill_dir
         tmp = os.path.join(target_root, f".tmp-{object_id}-{os.getpid()}")
         with open(tmp, "wb") as f:
@@ -287,30 +302,42 @@ class ObjectStore:
         """Deserialize an arena object under a read pin (native ownership:
         the C++ arena won't reclaim the bytes while the pin is held).
 
-        * value holds NO views into the arena (nbuf == 0): unpin now.
-        * value holds views and is weakref-able (arrays, DataFrames, model
-          objects — every large zero-copy case): the pin is released by a
-          finalizer when the value dies, so ``delete`` + block reuse can
-          never invalidate memory the value still references.
-        * value holds views but can't carry a finalizer (plain dict/list
-          containers): re-deserialize as copies, then unpin — correctness
-          over zero-copy for that minority shape.
+        The pin is released when the LAST out-of-band buffer holder dies
+        (``serialization.deserialize_pinned``), not when the top-level value
+        dies: a derived object that escapes its container — a Series pulled
+        out of a DataFrame, an array extracted from a dict — keeps its
+        holder alive through the buffer-protocol chain, so ``delete`` +
+        block reuse can never invalidate memory anything still references.
+        A value holding no views (nbuf == 0) unpins immediately.
         """
         import weakref
 
         try:
-            value, nbuf = serialization.deserialize_ex(view, zero_copy=True)
+            value, holders = serialization.deserialize_pinned(view)
         except BaseException:
             self._arena.unpin(object_id, offset)
             raise
-        if nbuf == 0:
+        if not holders:
             self._arena.unpin(object_id, offset)
             return value
-        try:
-            weakref.finalize(value, self._arena.unpin, object_id, offset)
-        except TypeError:
-            value = serialization.deserialize(view, zero_copy=False)
-            self._arena.unpin(object_id, offset)
+        import threading
+
+        # finalizers run in whichever thread drops the last reference, so
+        # the countdown must be atomic
+        lock = threading.Lock()
+        remaining = [len(holders)]
+        unpin = self._arena.unpin
+
+        def _release(lock=lock, remaining=remaining, unpin=unpin,
+                     object_id=object_id, offset=offset):
+            with lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if last:
+                unpin(object_id, offset)
+
+        for h in holders:
+            weakref.finalize(h, _release)
         return value
 
     def delete(self, object_id: str) -> None:

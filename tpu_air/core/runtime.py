@@ -267,12 +267,13 @@ def _kill_quietly(proc) -> None:
         pass
 
 
-def _sweep_stale_sessions(base: str) -> None:
+def _sweep_stale_sessions(base: str, spill_base: str = "/var/tmp") -> None:
     """Remove store dirs leaked by killed sessions (tmpfs is RAM — leaks
-    accumulate).  A dir is stale when untouched for _STALE_SESSION_AGE_S."""
+    accumulate).  A dir is stale when untouched for _STALE_SESSION_AGE_S.
+    ``spill_base`` is injectable for tests."""
     now = time.time()
     names = []
-    for d in (base, "/var/tmp"):  # /var/tmp: spill dirs of killed sessions
+    for d in (base, spill_base):  # spill_base: spill dirs of killed sessions
         try:
             names += [(d, n) for n in os.listdir(d)]
         except OSError:
@@ -280,24 +281,39 @@ def _sweep_stale_sessions(base: str) -> None:
     for d, name in names:
         if not name.startswith(("tpu_air-", "tpu_air-spill-")):
             continue
-        if d == "/var/tmp" and not name.startswith("tpu_air-spill-"):
+        if d == spill_base and not name.startswith("tpu_air-spill-"):
             continue
         path = os.path.join(d, name)
         try:
             if name.startswith("tpu_air-spill-"):
                 # a spill dir's mtime goes stale while its session still
                 # runs (spills may all happen early) — it is reapable only
-                # once the owning store root is gone from every base.  A
-                # custom store_root (owner not tpu_air-*) lives somewhere we
-                # can't check, so its spill dir is user-managed: never sweep.
-                owner = name[len("tpu_air-spill-"):]
-                if not owner.startswith("tpu_air-"):
-                    continue
-                if any(
-                    os.path.exists(os.path.join(b, owner))
-                    for b in ("/dev/shm", tempfile.gettempdir())
-                ):
-                    continue
+                # once the owning store root is gone.  The dir carries an
+                # ``.owner`` marker naming the root's absolute path
+                # (ObjectStore._ensure_spill_dir), so liveness is checked
+                # against THAT path — a custom-base root named tpu_air-*
+                # is not mistaken for dead just because it isn't under a
+                # default base.  No marker (pre-marker sessions): fall back
+                # to probing the default bases, and never sweep owners that
+                # aren't tpu_air-* (they live somewhere we can't check).
+                owner_root = None
+                try:
+                    with open(os.path.join(path, ".owner")) as f:
+                        owner_root = f.read().strip()
+                except OSError:
+                    pass
+                if owner_root:
+                    if os.path.exists(owner_root):
+                        continue
+                else:
+                    owner = name[len("tpu_air-spill-"):]
+                    if not owner.startswith("tpu_air-"):
+                        continue
+                    if any(
+                        os.path.exists(os.path.join(b, owner))
+                        for b in ("/dev/shm", tempfile.gettempdir())
+                    ):
+                        continue
             if now - os.path.getmtime(path) < _STALE_SESSION_AGE_S:
                 continue
             for f in os.listdir(path):

@@ -55,12 +55,8 @@ def deserialize(buf, zero_copy: bool = True) -> Any:
     return deserialize_ex(buf, zero_copy=zero_copy)[0]
 
 
-def deserialize_ex(buf, zero_copy: bool = True) -> Tuple[Any, int]:
-    """Like :func:`deserialize`, also returning the out-of-band buffer count.
-
-    ``nbuf == 0`` means the value is fully self-contained (no views into
-    ``buf``) — the object store uses this to release its read pin
-    immediately instead of tying it to the value's lifetime."""
+def _parse_wire(buf) -> Tuple[memoryview, List[memoryview]]:
+    """Split a wire-format buffer into (pickle payload, oob piece views)."""
     mv = memoryview(buf)
     npickle, nbuf = _HDR.unpack_from(mv, 0)
     off = _HDR.size
@@ -71,12 +67,42 @@ def deserialize_ex(buf, zero_copy: bool = True) -> Tuple[Any, int]:
         off += _LEN.size
     payload = mv[off : off + npickle]
     off += npickle
-    oob: List[Any] = []
+    pieces: List[memoryview] = []
     for n in lens:
-        piece = mv[off : off + n]
-        oob.append(piece if zero_copy else piece.tobytes())
+        pieces.append(mv[off : off + n])
         off += n
-    return pickle.loads(payload, buffers=oob), nbuf
+    return payload, pieces
+
+
+def deserialize_ex(buf, zero_copy: bool = True) -> Tuple[Any, int]:
+    """Like :func:`deserialize`, also returning the out-of-band buffer count.
+
+    ``nbuf == 0`` means the value is fully self-contained (no views into
+    ``buf``) — the object store uses this to release its read pin
+    immediately instead of tying it to the value's lifetime."""
+    payload, pieces = _parse_wire(buf)
+    oob = pieces if zero_copy else [p.tobytes() for p in pieces]
+    return pickle.loads(payload, buffers=oob), len(pieces)
+
+
+def deserialize_pinned(buf) -> Tuple[Any, List[Any]]:
+    """Zero-copy deserialize returning weakref-able out-of-band holders.
+
+    Each out-of-band piece is wrapped in a uint8 ndarray *holder* and the
+    holders are handed to ``pickle.loads`` as the buffers.  Anything pickle
+    reconstructs over a piece keeps its holder alive through the
+    buffer-protocol chain (reconstructed array → base memoryview → exporter
+    = holder), including objects later *derived* from the value — a Series
+    pulled out of a DataFrame, an array extracted from a dict.  A resource
+    pinned until every returned holder is garbage therefore outlives every
+    object that can still reach the underlying bytes, which a finalizer on
+    the top-level value alone cannot guarantee.
+    """
+    import numpy as np
+
+    payload, pieces = _parse_wire(buf)
+    holders = [np.frombuffer(p, dtype=np.uint8) for p in pieces]
+    return pickle.loads(payload, buffers=holders), holders
 
 
 def dumps(value: Any) -> bytes:

@@ -27,6 +27,15 @@ regardless.  Both the attention output and the logsumexp are
 differentiable — the lse cotangent folds into the backward's delta term —
 so ring attention (ring_attention.py) trains through merged stats on the
 kernel path.
+
+Fully-masked rows (a query whose ``kv_mask`` hides EVERY key): the forward
+emits mean(V) — matching the dense reference, whose softmax over an all
+-masked row degenerates to uniform weights — but the custom VJP defines the
+gradient of such a row as exactly ZERO dq/dk/dv, where autodiff of the
+computed function would give a nonzero uniform dv.  This is deliberate:
+a fully-masked row is padding, and padding must not train.  SP/ring users
+who pad whole rows get zero gradients for them by contract (see
+``_bwd_p``).
 """
 
 from __future__ import annotations

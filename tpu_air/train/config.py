@@ -47,12 +47,14 @@ class ScalingConfig:
     def __post_init__(self):
         if self.use_gpu is not None:
             self.use_tpu = bool(self.use_gpu)
+        # validate BEFORE the `or 1` defaulting: an explicit 0 must raise,
+        # not silently train replicated
+        if self.model_parallel is not None and self.model_parallel < 1:
+            raise ValueError("model_parallel must be >= 1")
+        if self.sequence_parallel is not None and self.sequence_parallel < 1:
+            raise ValueError("sequence_parallel must be >= 1")
         self.model_parallel = self.model_parallel or 1
         self.sequence_parallel = self.sequence_parallel or 1
-        if self.model_parallel < 1:
-            raise ValueError("model_parallel must be >= 1")
-        if self.sequence_parallel < 1:
-            raise ValueError("sequence_parallel must be >= 1")
         # a worker's chips must cover the PRODUCT of its in-worker axes —
         # validating against each degree separately would silently accept
         # model_parallel=2, sequence_parallel=2 on 2 chips
