@@ -280,11 +280,54 @@ def _measure_long_context_attention(seq_len=4096, bh=48, d=64, n=6):
     }
 
 
+_HBM_PEAK_GBPS = {
+    # datasheet HBM bandwidth by device kind (GB/s)
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v4": 1228.0,
+    "TPU v5p": 2765.0,
+}
+
+
+def _decode_step_bytes(config, batch, enc_len, max_decode_len) -> dict:
+    """HBM traffic model for ONE cached decode step (bf16/f32 by config).
+
+    Every step must stream: the cross-attention K/V cache (invariant, read
+    in full), the self-attention cache slabs (padded to max_decode_len —
+    the einsum reads the whole slab), and the decoder-side parameters
+    (incl. the LM head matrix).  Activations at qlen=1 are negligible.
+    """
+    bytes_el = 2 if "bfloat16" in str(config.dtype) else 4
+    h_d = config.num_heads * config.d_kv
+    layers = config.num_decoder_layers
+    cross_kv = 2 * batch * enc_len * h_d * bytes_el * layers
+    self_kv = 2 * batch * max_decode_len * h_d * bytes_el * layers
+    # decoder params per layer: self q/k/v/o + cross q/o (cross k/v cached)
+    # + FFN (gated: wi_0, wi_1, wo)
+    d, ff = config.d_model, config.d_ff
+    ffn_mats = 3 if getattr(config, "is_gated_act", False) else 2
+    p_layer = (4 * d * h_d + 2 * d * h_d + ffn_mats * d * ff)
+    head = d * config.vocab_size  # lm head / tied embedding read
+    params_b = (layers * p_layer + head) * bytes_el
+    return {
+        "cross_kv_bytes": cross_kv,
+        "self_kv_bytes": self_kv,
+        "param_bytes": params_b,
+        "total_bytes": cross_kv + self_kv + params_b,
+    }
+
+
 def _measure_generation(model, config, params, batch=256, enc_len=512,
                         max_new_tokens=128):
     """W3 batch-generation throughput (seq/sec/chip): greedy KV-cache decode
     at the reference's dials (batch_size=256, max_new_tokens=128 —
-    Model_finetuning_and_batch_inference.ipynb:cc-67)."""
+    Model_finetuning_and_batch_inference.ipynb:cc-67).
+
+    Also reports a per-decode-step roofline: per-step ms comes from the
+    SLOPE between a 128-token and a 64-token decode (same encode + cache
+    init on both sides, so the difference is 64 pure decode steps), and
+    achieved GB/s divides the step's modeled HBM traffic
+    (``_decode_step_bytes``) by that time."""
     import jax
     import jax.numpy as jnp
 
@@ -305,7 +348,7 @@ def _measure_generation(model, config, params, batch=256, enc_len=512,
     marginal = (time.perf_counter() - t0) - t1
     valid = marginal > 0.5 * t1
     per = marginal if valid else t1
-    return {
+    out = {
         "batch": batch,
         "enc_len": enc_len,
         "max_new_tokens": max_new_tokens,
@@ -314,6 +357,30 @@ def _measure_generation(model, config, params, batch=256, enc_len=512,
         "call_s": round(per, 3),
         "measurement_valid": valid,
     }
+    try:
+        half = max_new_tokens // 2
+        fn_half = make_generate_fn(model, half, False, 1.0, 0)
+        int(jnp.sum(fn_half(params, ids, mask, rng)))  # compile + warm
+        t_half = _med3(lambda: int(jnp.sum(fn_half(params, ids, mask, rng))))
+        step_s = (t1 - t_half) / (max_new_tokens - half)
+        bytes_model = _decode_step_bytes(config, batch, enc_len,
+                                         max_new_tokens + 1)
+        dev = jax.devices()[0]
+        peak = _HBM_PEAK_GBPS.get(dev.device_kind)
+        achieved = bytes_model["total_bytes"] / step_s / 1e9 if step_s > 0 else None
+        out["decode_step"] = {
+            "per_step_ms": round(step_s * 1e3, 3),
+            "modeled_hbm_bytes": bytes_model,
+            "achieved_gb_per_s": round(achieved, 1) if achieved else None,
+            "hbm_peak_gb_per_s": peak,
+            "fraction_of_roofline": (
+                round(achieved / peak, 3) if achieved and peak else None
+            ),
+            "slope_valid": step_s > 0,
+        }
+    except Exception as e:  # noqa: BLE001 — roofline is additive, never fatal
+        out["decode_step_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def _child_main() -> None:

@@ -23,8 +23,16 @@ from .config import T5Config
 from .modeling import T5ForConditionalGeneration
 
 
-def init_cache(model, batch_size: int, max_decode_len: int, enc_hidden, enc_mask):
-    """Zero-filled decode cache with the right structure, via eval_shape."""
+def init_cache(model, params, batch_size: int, max_decode_len: int,
+               enc_hidden, enc_mask):
+    """Build the decode cache.
+
+    Self-attention slabs and bookkeeping come from ``eval_shape`` (free);
+    the cross-attention K/V — an invariant of the encoder output — come
+    from ONE real qlen-1 decoder pass (``init_decode_cache``) whose only
+    meaningful compute is the per-layer K/V projections of ``enc_hidden``.
+    The two trees are grafted: everything under a ``cross_attn`` module is
+    taken from the real pass, the rest from the zeroed full-size tree."""
 
     def _init():
         return model.init(
@@ -37,7 +45,30 @@ def init_cache(model, batch_size: int, max_decode_len: int, enc_hidden, enc_mask
         )
 
     shapes = jax.eval_shape(_init)["cache"]
-    return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
+    _, vars1 = model.apply(
+        {"params": params},
+        jnp.zeros((batch_size, 1), jnp.int32),
+        enc_hidden,
+        enc_mask,
+        mutable=["cache"],
+        method=model.init_decode_cache,
+    )
+
+    def graft(dst, src, under_cross=False):
+        for k, v in src.items():
+            if isinstance(v, dict):
+                graft(dst[k], v, under_cross or k == "cross_attn")
+            elif under_cross:
+                dst[k] = v
+
+    from flax.core import unfreeze
+
+    cache = unfreeze(cache)
+    graft(cache, unfreeze(vars1["cache"]))
+    return cache
 
 
 from tpu_air.models.sampling import sample_token as _sample_token  # noqa: E402
@@ -63,7 +94,8 @@ def make_generate_fn(
         enc = model.apply(
             {"params": params}, input_ids, attention_mask, method=model.encode
         )
-        cache = init_cache(model, batch, max_new_tokens + 1, enc, attention_mask)
+        cache = init_cache(model, params, batch, max_new_tokens + 1, enc,
+                           attention_mask)
         tok0 = jnp.full((batch,), start_id, dtype=jnp.int32)
         finished0 = jnp.zeros((batch,), dtype=jnp.bool_)
 

@@ -122,6 +122,7 @@ class Attention(nn.Module):
         kv_mask: Optional[Array] = None,  # [batch, klen] 1=attend (structured)
         causal: bool = False,             # structured causal flag
         decode: bool = False,
+        cross_decode: bool = False,
         deterministic: bool = True,
     ) -> Array:
         cfg = self.config
@@ -135,8 +136,20 @@ class Attention(nn.Module):
             )
 
         q = dense("q")(hidden)           # [b, q, h, d]
-        k = dense("k")(kv_hidden)        # [b, k, h, d]
-        v = dense("v")(kv_hidden)
+        if cross_decode and self.has_variable("cache", "cached_key"):
+            # Cross-attention during cached decode: K/V are an invariant of
+            # the encoder output, computed ONCE at cache init.  Recomputing
+            # the two 512-token projections per decode step was the dominant
+            # cost of W3 generation (~12 layers x 2 projections x the full
+            # encoder length, per emitted token).
+            k = self.get_variable("cache", "cached_key")
+            v = self.get_variable("cache", "cached_value")
+        else:
+            k = dense("k")(kv_hidden)    # [b, k, h, d]
+            v = dense("v")(kv_hidden)
+            if cross_decode:
+                self.variable("cache", "cached_key", lambda: k)
+                self.variable("cache", "cached_value", lambda: v)
 
         if decode:
             # Cache layout [b, max_len, h, d]; cache vars are created ahead of
@@ -268,7 +281,8 @@ class DecoderLayer(nn.Module):
         )
         h = RMSNorm(cfg.layer_norm_epsilon, _dtype(cfg), name="ln_cross")(x)
         x = x + Attention(cfg, name="cross_attn")(
-            h, enc, None, None, kv_mask=cross_kv_mask, deterministic=deterministic
+            h, enc, None, None, kv_mask=cross_kv_mask, cross_decode=decode,
+            deterministic=deterministic,
         )
         h = RMSNorm(cfg.layer_norm_epsilon, _dtype(cfg), name="ln_mlp")(x)
         x = x + FeedForward(cfg, name="mlp")(h, deterministic=deterministic)
@@ -316,10 +330,11 @@ class Decoder(nn.Module):
             # is carried by the attention cache afterwards; the caller passes
             # the same max_len via embeds at init, so derive klen from the
             # layer-0 cache when present.
-            if self.has_variable("cache", "decoder_max_len"):
-                klen = int(self.get_variable("cache", "decoder_max_len").shape[0])
-            else:
+            is_init = not self.has_variable("cache", "decoder_max_len")
+            if is_init:
                 klen = qlen
+            else:
+                klen = int(self.get_variable("cache", "decoder_max_len").shape[0])
             self.variable(
                 "cache", "decoder_max_len", jnp.zeros, (klen,), jnp.int8
             )
@@ -338,7 +353,10 @@ class Decoder(nn.Module):
                     x, enc, bias, self_mask=self_mask, cross_kv_mask=enc_mask,
                     decode=True, deterministic=deterministic,
                 )
-            pos.value = pos.value + qlen
+            if not is_init:
+                # the cache-init pass (a real apply now, so cross K/V get
+                # computed) is not a decoding step — position stays 0
+                pos.value = pos.value + qlen
             return RMSNorm(cfg.layer_norm_epsilon, dtype, name="final_ln")(x)
 
         positions = jnp.arange(qlen)
@@ -386,6 +404,20 @@ class T5ForConditionalGeneration(nn.Module):
             hidden = hidden * (cfg.d_model**-0.5)
             return hidden @ self.shared.embedding.T.astype(hidden.dtype)
         return self.lm_head(hidden)
+
+    def init_decode_cache(self, decoder_input_ids, encoder_hidden, encoder_mask):
+        """One real decoder pass (no LM head) whose purpose is the
+        CROSS-ATTENTION K/V: computed from the encoder output once and
+        stored in the cache, turning every subsequent decode step from
+        compute-bound (re-projecting the whole encoder sequence) into
+        bandwidth-bound (streaming the cached K/V).  Callers pass a qlen-1
+        dummy — the self-attention slabs this pass creates are wrong-sized
+        throwaways; ``generate.init_cache`` grafts only the ``cross_attn``
+        entries onto an eval_shape-zeroed full-size tree."""
+        self.decoder(
+            self.shared(decoder_input_ids), encoder_hidden, encoder_mask,
+            decode=True,
+        )
 
     def decode(
         self, decoder_input_ids, encoder_hidden, encoder_mask,
