@@ -309,3 +309,36 @@ def test_queued_actor_kill_cancels(air):
     with pytest.raises(tpu_air.TpuAirError):
         tpu_air.get(ref)
     tpu_air.kill(a)
+
+
+def test_chip_lease_shapes_follow_topology():
+    """docs/MULTIHOST.md §2 lease shapes, unit level: single-host
+    co-location with best-fit, whole-host cross-host spans with contiguity
+    preference, None when the request doesn't tile the free topology."""
+    from tpu_air.core.runtime import Runtime
+
+    rt = Runtime.__new__(Runtime)  # shape logic only — no processes
+    rt.num_chips = 16
+    rt.chips_per_host = 4
+    rt.free_chips = list(range(16))
+
+    l3 = rt._claim_chips(3)
+    assert len({c // 4 for c in l3}) == 1
+    # best-fit: the partially-used host (1 free chip) can't serve 2; a
+    # fresh host serves it without fragmenting the 1-free host further
+    l2 = rt._claim_chips(2)
+    assert len({c // 4 for c in l2}) == 1 and (l2[0] // 4) != (l3[0] // 4)
+    # 8 chips = 2 whole hosts, contiguous pair preferred
+    l8 = rt._claim_chips(8)
+    hosts8 = sorted({c // 4 for c in l8})
+    assert len(hosts8) == 2 and hosts8[1] - hosts8[0] == 1, hosts8
+    assert all(len([c for c in l8 if c // 4 == h]) == 4 for h in hosts8)
+    # nothing whole left: another 8-chip request must not be granted
+    assert rt._claim_chips(8) is None
+    # 1 chip still fits on the fragmented host
+    assert rt._claim_chips(1) is not None
+    # non-multiple spans never fit
+    assert rt._claim_chips(6) is None
+    # release everything; a 16-chip lease takes the whole slice
+    rt.free_chips = list(range(16))
+    assert sorted(rt._claim_chips(16)) == list(range(16))

@@ -114,14 +114,16 @@ class ObjectStore:
         path for liveness instead of guessing at default base dirs."""
         os.makedirs(self._spill_dir, exist_ok=True)
         marker = os.path.join(self._spill_dir, ".owner")
-        if not os.path.exists(marker):
-            tmp = f"{marker}.tmp-{os.getpid()}"
-            try:
-                with open(tmp, "w") as f:
-                    f.write(os.path.abspath(self.root))
-                os.rename(tmp, marker)
-            except OSError:
-                pass
+        # written unconditionally: a later session reusing the same spill-dir
+        # name (same root basename, different base dir) must not inherit a
+        # dead predecessor's marker — the sweeper would reap it as stale
+        tmp = f"{marker}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(os.path.abspath(self.root))
+            os.rename(tmp, marker)
+        except OSError:
+            pass
 
     # -- spilling ----------------------------------------------------------
     def _scan_files(self):

@@ -220,6 +220,11 @@ def _worker_main(
                 # Export the chip lease so the parallel layer (mesh.py) builds
                 # this actor's sub-mesh from exactly these devices.
                 os.environ["TPU_AIR_CHIP_IDS"] = ",".join(str(c) for c in chip_ids)
+            else:
+                # a chip-LESS actor must not inherit a lease from the parent
+                # env (e.g. forked mid-SPMD-fit while the driver holds the
+                # cluster lease in its own environ)
+                os.environ.pop("TPU_AIR_CHIP_IDS", None)
             cls, args, kwargs = _load_payload(store, spec)
             args, kwargs = _resolve_args(store, args, kwargs)
             _store_result(store, spec["task_id"], cls, args, kwargs)
@@ -346,6 +351,11 @@ class Runtime:
         if num_chips is None:
             num_chips = int(os.environ.get("TPU_AIR_NUM_CHIPS", "0") or 0)
         self.num_chips = num_chips
+        # Topology for lease SHAPES (docs/MULTIHOST.md §2): chip g lives on
+        # host g // chips_per_host.  Single host (the default) degenerates to
+        # chips_per_host == num_chips and the shape policy is a no-op.
+        cph = int(os.environ.get("TPU_AIR_CHIPS_PER_HOST", "0") or 0)
+        self.chips_per_host = cph if 0 < cph <= num_chips else (num_chips or 1)
         self.free_chips: List[int] = list(range(self.num_chips))
         self.avail = {"cpu": float(self.num_cpus), "chip": float(self.num_chips)}
         method = start_method or os.environ.get("TPU_AIR_START_METHOD", "fork")
@@ -634,6 +644,52 @@ class Runtime:
     def _can_fit(self, res: Dict[str, float]) -> bool:
         return all(self.avail.get(k, 0.0) >= v for k, v in res.items())
 
+    def _claim_chips(self, n: int) -> Optional[List[int]]:
+        """Topology-aware chip-lease allocation (docs/MULTIHOST.md §2).
+
+        Shapes: a lease of ``n <= chips_per_host`` chips lives entirely on
+        ONE host (best-fit: the feasible host with the fewest free chips, so
+        big leases aren't starved by fragmentation); a larger lease is built
+        from WHOLE free hosts (contiguous host range preferred — the induced
+        mesh's collectives then ride ICI), so it is always a contiguous
+        sub-slice rather than an arbitrary k-subset.  Returns None when the
+        request doesn't tile the free topology right now (caller keeps it
+        queued, FIFO).  Caller holds the lock.
+        """
+        if n == 0:
+            return []
+        cph = self.chips_per_host
+        by_host: Dict[int, List[int]] = {}
+        for c in sorted(self.free_chips):
+            by_host.setdefault(c // cph, []).append(c)
+        if n <= cph:
+            fitting = [h for h, f in by_host.items() if len(f) >= n]
+            if not fitting:
+                return None
+            host = min(fitting, key=lambda h: (len(by_host[h]), h))
+            ids = by_host[host][:n]
+        else:
+            if n % cph != 0:
+                return None
+            k = n // cph
+            full = sorted(h for h, f in by_host.items() if len(f) == cph)
+            if len(full) < k:
+                return None
+            # prefer a contiguous run of k hosts; fall back to any k full
+            # hosts (documented relaxation — strict contiguity could wedge
+            # a sweep forever on a fragmented slice)
+            chosen = None
+            for i in range(len(full) - k + 1):
+                if full[i + k - 1] - full[i] == k - 1:
+                    chosen = full[i : i + k]
+                    break
+            if chosen is None:
+                chosen = full[:k]
+            ids = [c for h in chosen for c in by_host[h]]
+        for c in ids:
+            self.free_chips.remove(c)
+        return ids
+
     def _acquire(self, res: Dict[str, float]):
         for k, v in res.items():
             self.avail[k] = self.avail.get(k, 0.0) - v
@@ -642,6 +698,30 @@ class Runtime:
         for k, v in res.items():
             self.avail[k] = self.avail.get(k, 0.0) + v
 
+    def lease_chips(self, n: int, timeout: Optional[float] = None) -> List[int]:
+        """Driver-level chip lease (shape-aware, docs/MULTIHOST.md §2) for
+        runs that execute on the driver itself rather than in an actor —
+        the SPMD-multihost trainer path.  Blocks until a correctly-shaped
+        lease frees up.  Pair with :meth:`release_chips`."""
+        self._check_satisfiable({"chip": float(n)})
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self.lock:
+                if self._can_fit({"chip": float(n)}):
+                    ids = self._claim_chips(n)
+                    if ids is not None:
+                        self._acquire({"chip": float(n)})
+                        return ids
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"no {n}-chip lease available after {timeout}s")
+            time.sleep(0.05)
+
+    def release_chips(self, chip_ids: List[int]) -> None:
+        with self.lock:
+            self._release({"chip": float(len(chip_ids))})
+            self.free_chips.extend(chip_ids)
+        self._schedule()
+
     def _check_satisfiable(self, res: Dict[str, float]):
         total = {"cpu": float(self.num_cpus), "chip": float(self.num_chips)}
         for k, v in res.items():
@@ -649,6 +729,13 @@ class Runtime:
                 raise TpuAirError(
                     f"resource request {res} exceeds cluster total {total}"
                 )
+        nchips = int(res.get("chip", 0))
+        if nchips > self.chips_per_host and nchips % self.chips_per_host != 0:
+            raise TpuAirError(
+                f"chip lease of {nchips} spans hosts and must be a multiple "
+                f"of chips_per_host={self.chips_per_host} (whole-host lease "
+                "shapes, docs/MULTIHOST.md)"
+            )
 
     # -- task submission -----------------------------------------------------
     def _pack_payload(self, payload_tuple) -> Tuple[Optional[bytes], Optional[str]]:
@@ -811,10 +898,15 @@ class Runtime:
                 rec = self.actor_queue[0]
                 if not self._can_fit(rec["resources"]):
                     break
+                nchips = int(rec["resources"].get("chip", 0))
+                # shape-aware claim: counts may fit while no valid lease
+                # SHAPE exists yet (e.g. 4 free chips spread over 2 hosts
+                # cannot serve a 4-chip single-host lease) — stay queued
+                chip_ids = self._claim_chips(nchips)
+                if chip_ids is None:
+                    break
                 self.actor_queue.pop(0)
                 self._acquire(rec["resources"])
-                nchips = int(rec["resources"].get("chip", 0))
-                chip_ids = [self.free_chips.pop(0) for _ in range(nchips)]
                 self._to_spawn.append((rec, chip_ids))
                 claimed = True
         if claimed:

@@ -68,6 +68,19 @@ def _routable_host(toward: Optional[str]) -> str:
 
 
 _initialized = False
+_ACTIVE_CLUSTER: Optional["LocalCluster"] = None
+
+
+def set_active_cluster(cluster) -> None:
+    """Register the cluster handle trainers use for SPMD-multihost fits
+    (docs/MULTIHOST.md §3: leases spanning hosts run through the agent
+    plane).  ``spawn_local_cluster`` registers automatically."""
+    global _ACTIVE_CLUSTER
+    _ACTIVE_CLUSTER = cluster
+
+
+def active_cluster():
+    return _ACTIVE_CLUSTER
 
 
 # --------------------------------------------------------------------------
@@ -368,11 +381,14 @@ class LocalCluster:
     def __init__(self, server: HostAgentServer, procs: List[subprocess.Popen],
                  gcs_proc: Optional[subprocess.Popen] = None,
                  gcs_address: Optional[str] = None,
-                 heartbeat: Optional[Any] = None):
+                 heartbeat: Optional[Any] = None,
+                 devices_per_process: int = 0):
         self.server = server
         self.procs = procs
         self.gcs_proc = gcs_proc
         self.gcs_address = gcs_address
+        self.num_processes = server.num_processes
+        self.devices_per_process = devices_per_process
         self._heartbeat = heartbeat
         self._gcs_client = None
 
@@ -396,6 +412,8 @@ class LocalCluster:
             return []
 
     def shutdown(self):
+        if active_cluster() is self:
+            set_active_cluster(None)
         self.server.shutdown()
         for p in self.procs:
             try:
@@ -484,6 +502,11 @@ def spawn_local_cluster(
     )
     os.environ["TPU_AIR_PROCESS_ID"] = "0"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # Global chip pool for the scheduler: every virtual device is a "chip",
+    # host boundaries at devices_per_process (lease shapes —
+    # docs/MULTIHOST.md §2).  A later tpu_air.init() picks these up.
+    os.environ["TPU_AIR_NUM_CHIPS"] = str(num_processes * devices_per_process)
+    os.environ["TPU_AIR_CHIPS_PER_HOST"] = str(devices_per_process)
     heartbeat = None
     if gcs_address:
         os.environ["TPU_AIR_GCS"] = gcs_address
@@ -509,4 +532,7 @@ def spawn_local_cluster(
         if heartbeat is not None:
             heartbeat.stop()
         raise TimeoutError("host agents failed to connect")
-    return LocalCluster(server, procs, gcs_proc, gcs_address, heartbeat)
+    cluster = LocalCluster(server, procs, gcs_proc, gcs_address, heartbeat,
+                           devices_per_process=devices_per_process)
+    set_active_cluster(cluster)
+    return cluster

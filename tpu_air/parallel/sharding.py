@@ -71,13 +71,27 @@ def t5_param_shardings(params, mesh) -> Any:
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+def _place(x, sharding):
+    """Put one host value onto a (possibly multihost) sharding.
+
+    ``device_put`` rejects shardings with non-addressable devices; in a
+    multi-controller run every host calls this in lockstep and supplies the
+    shards its local devices need via the callback."""
+    if jax.process_count() > 1:
+        import numpy as np
+
+        xa = np.asarray(x)
+        return jax.make_array_from_callback(
+            xa.shape, sharding, lambda idx, _xa=xa: _xa[idx]
+        )
+    return jax.device_put(x, sharding)
+
+
 def replicate(tree, mesh):
     sh = NamedSharding(mesh, P())
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+    return jax.tree_util.tree_map(lambda x: _place(x, sh), tree)
 
 
 def shard_params(params, mesh):
     shardings = t5_param_shardings(params, mesh)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, s), params, shardings
-    )
+    return jax.tree_util.tree_map(_place, params, shardings)

@@ -33,11 +33,39 @@ import cloudpickle
 import numpy as np
 
 
+def _leaf_to_numpy(x):
+    """Materialize one param leaf on this host.
+
+    Multihost leaves (a mesh spanning processes) can't go through
+    ``np.asarray`` — it rejects non-fully-addressable arrays.  Reconstruct
+    from the ADDRESSABLE shards instead: with the lease-shape policy
+    (model/sequence axes within a host, data across hosts —
+    docs/MULTIHOST.md §2) every host holds a complete copy of each leaf,
+    so no cross-host traffic is needed to checkpoint."""
+    if not hasattr(x, "is_fully_addressable") or x.is_fully_addressable:
+        return np.asarray(x)
+    if x.is_fully_replicated:
+        return np.asarray(x.addressable_shards[0].data)
+    first = np.asarray(x.addressable_shards[0].data)
+    out = np.zeros(x.shape, first.dtype)
+    filled = np.zeros(x.shape, bool)
+    for s in x.addressable_shards:
+        out[s.index] = np.asarray(s.data)
+        filled[s.index] = True
+    if not filled.all():
+        raise ValueError(
+            "param leaf is not reconstructible from this host's shards — "
+            "keep model/sequence mesh axes within one host (whole-host "
+            "lease shapes) so each host owns a full model copy"
+        )
+    return out
+
+
 def _params_to_msgpack(params) -> bytes:
     from flax import serialization
 
     return serialization.msgpack_serialize(
-        __import__("jax").tree_util.tree_map(np.asarray, params)
+        __import__("jax").tree_util.tree_map(_leaf_to_numpy, params)
     )
 
 

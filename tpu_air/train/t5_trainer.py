@@ -248,10 +248,32 @@ def t5_train_loop(config: Dict[str, Any]) -> None:
         loss, ntok = loss_from_batch(p, batch, None)
         return loss, ntok
 
+    multihost = jax.process_count() > 1
+
     def put_batch(b):
+        if multihost:
+            # every host iterates the same global batch (broadcast dataset);
+            # the callback hands each host's devices their slice — device_put
+            # rejects shardings with non-addressable devices
+            out = {}
+            for k, v in b.items():
+                xa = np.asarray(v)
+                out[k] = jax.make_array_from_callback(
+                    xa.shape, batch_sharding, lambda idx, _v=xa: _v[idx]
+                )
+            return out
         return {k: jax.device_put(jnp.asarray(v), batch_sharding) for k, v in b.items()}
 
-    rng = jax.device_put(jax.random.PRNGKey(args.seed + 1), rep)
+    if multihost:
+        # a host-local key is committed to a local device and may not mix
+        # with global-mesh arrays in one jit — build a replicated global key
+        # (identical bits on every host: same seed)
+        key_np = np.asarray(jax.random.PRNGKey(args.seed + 1))
+        rng = jax.make_array_from_callback(
+            key_np.shape, rep, lambda idx: key_np[idx]
+        )
+    else:
+        rng = jax.device_put(jax.random.PRNGKey(args.seed + 1), rep)
 
     # -- epochs -------------------------------------------------------------
     for epoch in range(int(args.num_train_epochs)):
@@ -281,6 +303,11 @@ def t5_train_loop(config: Dict[str, Any]) -> None:
             "train_tokens_per_sec_per_chip": (tokens / dt / ndev) if dt > 0 else 0.0,
             "mesh_data": dp,
             "mesh_model": tp,
+            # how many PROCESSES the mesh spans — the cross-host proof for
+            # the SPMD-multihost path (1 on a single host)
+            "mesh_num_hosts": len(
+                {getattr(d, "process_index", 0) for d in mesh.devices.flat}
+            ),
             "params_bytes_total": params_bytes_total,
             "params_bytes_per_device": params_bytes_per_device,
         }

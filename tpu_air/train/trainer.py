@@ -108,6 +108,33 @@ class _TrialRunner:
         return out
 
 
+class _BroadcastDataset:
+    """Pandas-backed dataset shim for SPMD-multihost fit broadcasts.
+
+    Host agents have no connection to the driver's object store, so
+    datasets are materialized on the driver and shipped by value inside the
+    broadcast thunk.  Covers the surface the built-in train loops use
+    (iter_batches / count / to_pandas); every host iterates the SAME rows
+    in the same order, and the loop's sharded batch placement gives each
+    host's devices their slice."""
+
+    def __init__(self, df):
+        self._df = df.reset_index(drop=True)
+
+    def count(self) -> int:
+        return len(self._df)
+
+    def to_pandas(self):
+        return self._df
+
+    def iter_batches(self, batch_size: int, batch_format: str = "pandas",
+                     drop_last: bool = False):
+        n = len(self._df)
+        end = n - (n % batch_size) if drop_last else n
+        for i in range(0, max(end, 0), batch_size):
+            yield self._df.iloc[i : i + batch_size]
+
+
 class BaseTrainer:
     """Shared fit() machinery.  Subclasses provide ``_training_fn()`` (a
     picklable function of one ``config`` dict that uses the session API)."""
@@ -179,6 +206,23 @@ class BaseTrainer:
             config.update(extra_config)
         config["_preprocessor"] = self.preprocessor
         config["_scaling_config"] = sc  # mesh topology source for the loop
+
+        # SPMD-multihost path (docs/MULTIHOST.md §3): a lease larger than one
+        # host cannot run in a single local actor — the jitted step must be
+        # ENTERED by every owning host.  Route the whole training function
+        # through the cluster's agent plane instead of a trial actor.
+        from tpu_air.parallel import distributed as _dist
+
+        cluster = _dist.active_cluster()
+        rt = tpu_air.core.runtime.get_runtime()
+        if (
+            cluster is not None
+            and getattr(cluster, "num_processes", 1) > 1
+            and (sc.total_chips or 0) > rt.chips_per_host
+        ):
+            return self._run_spmd_multihost(
+                datasets, run_dir, config, cluster, rt, resume
+            )
         attempt = 0
         while True:
             if resume is not None:
@@ -219,6 +263,101 @@ class BaseTrainer:
             return self._assemble(
                 out, run_dir, config, RuntimeError(err)
             )
+
+    def _run_spmd_multihost(
+        self, datasets, run_dir, config, cluster, rt, resume
+    ) -> Result:
+        """Run the training fn on EVERY host of the active cluster in
+        lockstep over a cross-host chip lease.  Host 0 (this process) keeps
+        the real session (reporting, checkpoint retention); other hosts run
+        throwaway replicas whose only output is their error status.  One
+        attempt (no FailureConfig retry on this path yet — a host loss kills
+        the fit; resume_from_checkpoint still works on the next call)."""
+        sc = self.scaling_config
+        rc = self.run_config
+        if resume is not None:
+            config["resume_from_checkpoint"] = (
+                resume.to_directory() if isinstance(resume, Checkpoint) else resume
+            )
+        lease = rt.lease_chips(sc.total_chips, timeout=300.0)
+        try:
+            return self._run_spmd_leased(
+                datasets, run_dir, config, cluster, rc, sc, lease
+            )
+        finally:
+            rt.release_chips(lease)
+
+    def _run_spmd_leased(
+        self, datasets, run_dir, config, cluster, rc, sc, lease
+    ) -> Result:
+        training_fn = self._training_fn()
+        dfs = {
+            k: ds.to_pandas() for k, ds in datasets.items() if ds is not None
+        }
+        ckpt_cfg = rc.checkpoint_config
+        world = sc.num_workers
+
+        def spmd_fit(
+            training_fn=training_fn, config=config, dfs=dfs, lease=lease,
+            run_dir=run_dir, ckpt_cfg=ckpt_cfg, world=world,
+        ):
+            import tempfile
+            import traceback as _tb
+
+            import jax
+
+            from tpu_air.train.session import Session, _set_active
+            from tpu_air.train.trainer import _BroadcastDataset
+
+            pid = jax.process_index()
+            prev_lease = os.environ.get("TPU_AIR_CHIP_IDS")
+            os.environ["TPU_AIR_CHIP_IDS"] = ",".join(str(c) for c in lease)
+            try:
+                ds = {k: _BroadcastDataset(df) for k, df in dfs.items()}
+                rd = run_dir if pid == 0 else tempfile.mkdtemp(
+                    prefix="tpu_air-spmd-replica-"
+                )
+                session = Session(
+                    run_dir=rd, checkpoint_config=ckpt_cfg, datasets=ds,
+                    config=config, world_size=world,
+                    sinks=None if pid == 0 else [],
+                )
+                _set_active(session)
+                out = {"error": None, "stopped": False}
+                try:
+                    training_fn(config)
+                except BaseException as e:  # noqa: BLE001 - trial boundary
+                    out["error"] = (
+                        f"{type(e).__name__}: {e}\n{_tb.format_exc()}"
+                    )
+                finally:
+                    _set_active(None)
+                    for sink in session.sinks:
+                        if hasattr(sink, "close"):
+                            sink.close()
+                if pid != 0:
+                    # replica output is discarded — reclaim the throwaway
+                    # run dir (it holds full checkpoint copies)
+                    import shutil
+
+                    shutil.rmtree(rd, ignore_errors=True)
+                    return {"error": out["error"], "replica": pid}
+                out["history"] = session.history
+                out["checkpoints"] = [(p, m) for p, m in session.checkpoints]
+                out["best_checkpoint"] = session.best_checkpoint()
+                out["latest_checkpoint"] = session.latest_checkpoint()
+                return out
+            finally:
+                if prev_lease is None:
+                    os.environ.pop("TPU_AIR_CHIP_IDS", None)
+                else:
+                    os.environ["TPU_AIR_CHIP_IDS"] = prev_lease
+
+        outs = cluster.run(spmd_fit)
+        out = outs[0]
+        errors = [o["error"] for o in outs if o.get("error")]
+        error = RuntimeError("\n---\n".join(errors)) if errors else None
+        return self._assemble(out, run_dir, config, error)
 
     def _assemble(self, out, run_dir, config, error) -> Result:
         best = out.get("best_checkpoint")
