@@ -1,0 +1,80 @@
+"""Bench artifact round-proofing (VERDICT r3 weak #1 / next-round #4): a
+valid on-TPU measurement persisted earlier in the round must be the round's
+HEADLINE when the tunnel wedges at capture time — not a footnote under a
+CPU number.  Simulates the wedge via the bench's probe-fail test hook."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(env_extra, last_entries, tmp_path, timeout=300):
+    last_path = tmp_path / "BENCH_LAST.json"
+    last_path.write_text(json.dumps(last_entries))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        TPU_AIR_BENCH_LAST_PATH=str(last_path),
+        TPU_AIR_BENCH_FORCE_PROBE_FAIL="1",
+        TPU_AIR_BENCH_PROBE_ATTEMPTS="1",
+        TPU_AIR_BENCH_PROBE_BACKOFF="0",
+    )
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")][-1]
+    return json.loads(line), json.loads(last_path.read_text())
+
+
+def test_wedge_at_capture_promotes_persisted_tpu_headline(tmp_path):
+    tpu_entry = {
+        "metric": "flan-t5-base fine-tune throughput (tpu)",
+        "value": 142848.0,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+        "platform": "tpu",
+        "device_kind": "TPU v5 lite",
+        "measurement_valid": True,
+        "recorded_at": time.time() - 3600,  # measured an hour ago, this round
+    }
+    result, last_after = _run_bench({}, {tpu_entry["metric"]: tpu_entry}, tmp_path)
+    # the HEADLINE is the round's TPU number, platform tpu
+    assert result["platform"] == "tpu"
+    assert result["value"] == 142848.0
+    assert result["headline_from"] == "persisted_tpu_measurement"
+    assert 0 < result["headline_age_s"] < 2 * 3600
+    assert result["capture_attempts"], "wedge evidence must be recorded"
+    # the promoted entry is NOT re-stamped (stale entries must age out)
+    stored = last_after[tpu_entry["metric"]]
+    assert abs(stored["recorded_at"] - tpu_entry["recorded_at"]) < 1.0
+
+
+def test_stale_tpu_entry_does_not_masquerade_as_this_round(tmp_path):
+    tpu_entry = {
+        "metric": "flan-t5-base fine-tune throughput (tpu)",
+        "value": 99999.0,
+        "platform": "tpu",
+        "measurement_valid": True,
+        "recorded_at": time.time() - 14 * 24 * 3600,  # two weeks old
+    }
+    # tight headline window + skip the CPU smoke fallback by capping its
+    # runtime is not possible; instead run the real CPU fallback (tiny
+    # dials) and assert the stale entry was NOT promoted
+    result, _ = _run_bench(
+        {"TPU_AIR_BENCH_HEADLINE_MAX_AGE": "3600"},
+        {tpu_entry["metric"]: tpu_entry},
+        tmp_path,
+        timeout=900,
+    )
+    assert result.get("headline_from") is None
+    assert result["platform"] in ("cpu", "none")
+    if result["platform"] == "cpu":
+        assert result["fallback_reason"]["attempts"]
