@@ -418,6 +418,10 @@ def _child_main() -> None:
 
     results = {}
     flash_error = None
+    # force the einsum path for this row (attention_impl defaults to "auto",
+    # which at these dials picks einsum anyway — but the row label is a
+    # claim about WHICH kernel ran, so pin it)
+    config.attention_impl = "einsum"
     meas = _measure_slope(model, config, params, batch, enc_len, dec_len, steps_short)
     results["einsum"] = meas
     # flash path (Pallas kernel) — only meaningful where the kernel runs (TPU)

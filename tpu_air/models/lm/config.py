@@ -30,7 +30,12 @@ class LMConfig:
     dropout_rate: float = 0.0
     dtype: str = "float32"
     tie_embeddings: bool = True
-    attention: str = "dense"          # dense | flash | ring
+    # "auto" picks per-trace by sequence length: dense below
+    # flash_min_seq_len, the Pallas flash kernel at/above it (measured v5e
+    # crossover — BASELINE.md kernel table).  "ring" stays explicit: it
+    # needs a sequence mesh axis.
+    attention: str = "auto"           # auto | dense | flash | ring
+    flash_min_seq_len: int = 1024
     sequence_axis: Optional[str] = None  # mesh axis for ring attention
     # None -> kernel's measured-on-TPU auto tiling (512/1024 caps)
     block_q: Optional[int] = None

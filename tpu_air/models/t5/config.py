@@ -36,9 +36,17 @@ class T5Config:
     # dtype policy: bf16 activations on TPU (fp16-on-GPU analog of
     # Model_finetuning…ipynb:cc-64), fp32 params.
     dtype: str = "float32"
-    # Pallas blockwise attention (ops/flash_attention.py) for non-decode
-    # paths; falls back to the XLA einsum path when attention dropout is
-    # active or during cached decode.
+    # Attention dispatch.  ``attention_impl`` picks per-call at TRACE time:
+    # * "auto"   — einsum below ``flash_min_seq_len``, Pallas flash at or
+    #   above it (the measured v5e crossover: dense wins at 512, flash is
+    #   3.5-5x at >=2048 — BASELINE.md kernel table); no user flag needed.
+    # * "einsum" — always the XLA dense path.
+    # * "flash"  — always the Pallas kernel where eligible.
+    # Flash is only eligible off the cached-decode path with structured
+    # masks and inactive attention dropout (see modeling.Attention).
+    # ``use_flash_attention`` is the legacy force-flash switch (== "flash").
+    attention_impl: str = "auto"
+    flash_min_seq_len: int = 1024
     use_flash_attention: bool = False
 
     def __post_init__(self):

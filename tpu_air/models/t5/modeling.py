@@ -173,13 +173,22 @@ class Attention(nn.Module):
         # tensor), we're not in cached decode (qlen == 1 per-token launches
         # are a perf cliff; XLA's einsum path wins there), and attention
         # dropout is inactive (flash streams probabilities — there is no
-        # materialized matrix to drop out of).
-        use_flash = (
-            cfg.use_flash_attention
-            and not decode
+        # materialized matrix to drop out of).  Dispatch among eligible
+        # paths is by SHAPE at trace time (config.attention_impl="auto"):
+        # einsum below the measured crossover, flash at/above it.
+        eligible = (
+            not decode
             and qlen > 1
             and mask is None
             and (deterministic or cfg.dropout_rate == 0)
+        )
+        impl = "flash" if cfg.use_flash_attention else getattr(
+            cfg, "attention_impl", "auto"
+        )
+        use_flash = eligible and (
+            impl == "flash"
+            or (impl == "auto"
+                and max(qlen, klen) >= getattr(cfg, "flash_min_seq_len", 1024))
         )
         if use_flash:
             from tpu_air.ops import flash_attention
