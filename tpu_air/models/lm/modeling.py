@@ -120,8 +120,17 @@ class CausalSelfAttention(nn.Module):
         if impl == "auto":
             # trace-time shape dispatch: the einsum path wins short
             # sequences, the Pallas kernel wins at/above the measured
-            # crossover (no user flag — VERDICT r3 weak #2)
-            impl = "flash" if l >= getattr(cfg, "flash_min_seq_len", 1024) else "dense"
+            # crossover (no user flag — VERDICT r3 weak #2); off-TPU and
+            # tile-degenerate shapes stay dense (interpret-mode flash and
+            # 1-wide tiles are both perf cliffs)
+            from tpu_air.ops.flash_attention import auto_dispatch_ok
+
+            impl = (
+                "flash"
+                if l >= getattr(cfg, "flash_min_seq_len", 1024)
+                and auto_dispatch_ok(l, l)
+                else "dense"
+            )
         if impl == "ring":
             if cfg.sequence_axis is None:
                 raise ValueError('attention="ring" requires sequence_axis')

@@ -185,11 +185,15 @@ class Attention(nn.Module):
         impl = "flash" if cfg.use_flash_attention else getattr(
             cfg, "attention_impl", "auto"
         )
-        use_flash = eligible and (
-            impl == "flash"
-            or (impl == "auto"
-                and max(qlen, klen) >= getattr(cfg, "flash_min_seq_len", 1024))
-        )
+        if impl == "auto":
+            from tpu_air.ops.flash_attention import auto_dispatch_ok
+
+            use_flash = eligible and (
+                max(qlen, klen) >= getattr(cfg, "flash_min_seq_len", 1024)
+                and auto_dispatch_ok(qlen, klen)
+            )
+        else:
+            use_flash = eligible and impl == "flash"
         if use_flash:
             from tpu_air.ops import flash_attention
 

@@ -157,6 +157,24 @@ def _auto_block(length: int, cap: int) -> int:
     return 1
 
 
+def auto_dispatch_ok(qlen: int, klen: int) -> bool:
+    """Should attention_impl="auto" route this shape to the flash kernel?
+
+    Two gates beyond the caller's seq-length crossover check:
+    * backend must be TPU — off-TPU the kernel runs in Pallas INTERPRET
+      mode, orders of magnitude slower than einsum regardless of length;
+    * the auto tiling must find real tiles — an awkward length (no
+      power-of-two-ish divisor) degrades to 1-wide tiles, the ~1/8-MXU-rate
+      cliff, so einsum wins there too.
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False
+    return (_auto_block(qlen, _AUTO_BLOCK_Q_CAP) >= 128
+            and _auto_block(klen, _AUTO_BLOCK_K_CAP) >= 128)
+
+
 def _pallas_fwd(q, k, v, bias, kv_mask, scale, causal, block_q, block_k, interpret):
     bh, lq, d = q.shape
     lk = k.shape[1]

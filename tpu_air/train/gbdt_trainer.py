@@ -4,11 +4,16 @@ The reference trains XGBoost (C++ + rabit allreduce) via
 ``XGBoostTrainer(label_column, num_boost_round, params, datasets,
 preprocessor)``.  Per SURVEY.md §2B, GBDTs are out of the TPU north-star
 scope but a required workshop capability, kept as host-CPU training behind
-the same Trainer API.  This environment has no xgboost wheel, so the backend
-is sklearn gradient boosting; the config surface accepts the XGBoost param
-names the reference passes (objective, tree_method, eta, max_depth,
-min_child_weight) and reports the reference's metric names
-(``train-logloss``/``train-error``/``valid-error``, Introduction…ipynb:cc-40).
+the same Trainer API.  This environment has no xgboost wheel, so the
+default backend is the in-repo histogram booster (``hist_gbdt.HistGBDT``)
+with RABIT SEMANTICS for distributed training: per-node gradient/hessian
+histograms are allreduced over the collectives facade and every rank grows
+the bit-identical tree — not a bagging approximation.  The config surface
+accepts the XGBoost param names the reference passes (objective,
+tree_method, eta, max_depth, min_child_weight, lambda) and reports the
+reference's metric names (``train-logloss``/``train-error``/
+``valid-error``, Introduction…ipynb:cc-40).  ``params={"backend":
+"sklearn"}`` keeps the sklearn warm-start estimator (single-process only).
 """
 
 from __future__ import annotations
@@ -18,6 +23,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from .checkpoint import Checkpoint
+from .hist_gbdt import CollectivesComm, HistGBDT
 from .trainer import BaseTrainer
 
 
@@ -27,10 +33,48 @@ def _logloss(y, p):
     return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
 
 
+def _hist_model(params: Dict[str, Any], objective: str) -> HistGBDT:
+    return HistGBDT(
+        objective=objective,
+        eta=float(params.get("eta", 0.3)),
+        max_depth=int(params.get("max_depth", 6)),
+        min_child_weight=float(params.get("min_child_weight", 1.0)),
+        reg_lambda=float(params.get("lambda", 1.0)),
+        max_bins=int(params.get("max_bin", 256)),
+    )
+
+
+def _hist_metrics_from_sums(merged: Dict[str, float], is_classif: bool,
+                            i: int) -> Dict[str, Any]:
+    metrics: Dict[str, Any] = {"iteration": i}
+    if is_classif:
+        metrics["train-logloss"] = float(merged["ll_sum"] / merged["n"])
+        metrics["train-error"] = float(merged["err_sum"] / merged["n"])
+    else:
+        metrics["train-rmse"] = float(np.sqrt(merged["se_sum"] / merged["n"]))
+    return metrics
+
+
+def _valid_metrics(model, Xv, yv, is_classif: bool) -> Dict[str, float]:
+    """Validation metrics in the reference's names, shared by the single-
+    process and distributed paths."""
+    if Xv is None:
+        return {}
+    if is_classif:
+        pv = model.predict_proba(Xv)[:, 1]
+        return {
+            "valid-error": float(np.mean((pv > 0.5) != yv)),
+            "valid-logloss": _logloss(yv, pv),
+        }
+    pv = model.predict(Xv)
+    return {"valid-rmse": float(np.sqrt(np.mean((pv - yv) ** 2)))}
+
+
 class BaggedGBDT:
-    """Merged model from distributed training: each worker trained on its
-    row shard; the ensemble averages their predictions (the bagging merge —
-    the sklearn-backend analog of rabit's allreduce-merged boosters)."""
+    """Unpickle-compat shim for checkpoints written by the pre-round-4
+    DISTRIBUTED sklearn backend (which bagged per-rank estimators).  New
+    distributed training produces a single merged-histogram ``HistGBDT``;
+    this class only keeps old extras.pkl artifacts loadable/scorable."""
 
     def __init__(self, models, is_classif: bool):
         self.models = list(models)
@@ -40,9 +84,6 @@ class BaggedGBDT:
         return np.mean([m.predict_proba(X) for m in self.models], axis=0)
 
     def __getattr__(self, name):
-        # expose predict_proba ONLY for classifier ensembles, so
-        # hasattr(model, "predict_proba") — the branch GBDTPredictor takes —
-        # stays honest for bagged regressors
         if name == "predict_proba" and self.__dict__.get("_is_classif"):
             return self._bagged_proba
         raise AttributeError(name)
@@ -72,8 +113,6 @@ def _df_to_xy(df, label_column):
 
 
 def gbdt_train_loop(config: Dict[str, Any]) -> None:
-    from sklearn.ensemble import GradientBoostingClassifier, GradientBoostingRegressor
-
     from tpu_air.train import session
 
     params = dict(config.get("params", {}))
@@ -84,12 +123,16 @@ def gbdt_train_loop(config: Dict[str, Any]) -> None:
 
     world = int(getattr(config.get("_scaling_config"), "num_workers", 1) or 1)
     if world > 1:
+        if params.get("backend", "hist") == "sklearn":
+            raise ValueError(
+                'params={"backend": "sklearn"} supports single-process '
+                "training only — distributed GBDT always uses the "
+                "histogram-allreduce backend (rabit semantics)"
+            )
         _distributed_gbdt_loop(
             config, world, label_column, num_boost_round, objective, is_classif
         )
         return
-
-    sk_params = _sk_params(params, num_boost_round)
 
     train_ds = session.get_dataset_shard("train")
     valid_ds = session.get_dataset_shard("valid")
@@ -104,6 +147,16 @@ def gbdt_train_loop(config: Dict[str, Any]) -> None:
         yv = vdf[label_column].to_numpy()
         Xv = vdf.drop(columns=[label_column]).to_numpy(dtype=np.float64)
 
+    if params.get("backend", "hist") != "sklearn":
+        _hist_single_loop(
+            config, params, label_column, num_boost_round, objective,
+            is_classif, df, X, y, Xv, yv,
+        )
+        return
+
+    from sklearn.ensemble import GradientBoostingClassifier, GradientBoostingRegressor
+
+    sk_params = _sk_params(params, num_boost_round)
     cls = GradientBoostingClassifier if is_classif else GradientBoostingRegressor
     # warm_start: each loop turn grows the ensemble by ONE round and reports
     # before fitting the next — an ASHA stop (session.report raises StopTrial)
@@ -158,6 +211,43 @@ def gbdt_train_loop(config: Dict[str, Any]) -> None:
         session.report(metrics, checkpoint=ckpt(metrics) if want_ckpt else None)
 
 
+def _hist_single_loop(config, params, label_column, num_boost_round,
+                      objective, is_classif, df, X, y, Xv, yv) -> None:
+    """Single-process histogram boosting — the world_size=1 case of the SAME
+    algorithm the distributed path runs, so metrics agree in kind across
+    num_workers."""
+    from tpu_air.train import session
+
+    model = _hist_model(params, objective)
+    model.setup(X, y)
+    preprocessor = config.get("_preprocessor")
+    feature_columns = [c for c in df.columns if c != label_column]
+
+    def ckpt(metrics, i):
+        return Checkpoint.from_model(
+            preprocessor=preprocessor,
+            metrics=metrics,
+            extras={
+                "sklearn_model": model.scoring_copy(),
+                "label_column": label_column,
+                "feature_columns": feature_columns,
+                "objective": objective,
+                "rounds_fit": int(i),
+                "backend": "hist",
+            },
+        )
+
+    for i in range(1, num_boost_round + 1):
+        model.fit_one_round()
+        metrics = _hist_metrics_from_sums(
+            model.local_metric_sums(), is_classif, i
+        )
+        metrics.update(_valid_metrics(model, Xv, yv, is_classif))
+        stride = max(1, num_boost_round // 20)
+        want_ckpt = (i % stride == 0) or (i == num_boost_round)
+        session.report(metrics, checkpoint=ckpt(metrics, i) if want_ckpt else None)
+
+
 def _make_gbdt_worker_cls():
     """Actor class for one distributed-GBDT worker (built lazily so module
     import never requires a live runtime)."""
@@ -165,108 +255,56 @@ def _make_gbdt_worker_cls():
 
     @tpu_air.remote
     class _GBDTWorker:
-        """One rank of a distributed GBDT fit (the rabit-worker analog,
-        Introduction…ipynb:cc-32: XGBoostTrainer with 5 workers).
+        """One rank of a distributed GBDT fit — the rabit-worker analog
+        (Introduction…ipynb:cc-32: XGBoostTrainer with 5 workers).
 
-        Holds ONLY its row shard of the training data; per round it fits one
-        more stage locally, then allreduces (via the host-side collectives
-        facade, SURVEY.md §2D) the train-metric sums and its validation
-        probabilities so every rank — and the coordinating trial loop via
-        rank 0's return — sees the merged ensemble's metrics."""
+        Holds ONLY its row shard; per round, per tree depth, the
+        (node, feature, bin) gradient/hessian histograms are allreduced
+        over the collectives facade (SURVEY.md §2D) and every rank grows
+        the bit-identical tree from the merged statistics — true
+        distributed BOOSTING, not bagging."""
 
         def __init__(self, rank, world_size, shard, valid_ds, label_column,
-                     sk_params, is_classif, run_name):
-            from sklearn.ensemble import (
-                GradientBoostingClassifier,
-                GradientBoostingRegressor,
-            )
-
+                     params, objective, is_classif, run_name):
             self.rank = rank
             self.world = world_size
-            self.run_name = run_name
             self.is_classif = is_classif
-            self.X, self.y = _df_to_xy(shard.to_pandas(), label_column)
+            self.comm = CollectivesComm(rank, world_size, run_name)
+            X, y = _df_to_xy(shard.to_pandas(), label_column)
             self.Xv = self.yv = None
             if valid_ds is not None:
                 self.Xv, self.yv = _df_to_xy(valid_ds.to_pandas(), label_column)
-            cls = GradientBoostingClassifier if is_classif else GradientBoostingRegressor
-            sk = dict(sk_params)
-            sk["random_state"] = int(sk.get("random_state", 0)) + rank
-            self.model = cls(**sk, warm_start=True)
+            self.model = _hist_model(params, objective)
+            # merged bin edges: an allgather — every rank ends with the
+            # identical binning
+            self.model.setup(X, y, comm=self.comm)
 
         def fit_round(self, i: int):
-            from tpu_air.parallel.collectives import allreduce, gather
-
-            self.model.n_estimators = i
-            self.model.fit(self.X, self.y)
-            n = len(self.y)
-            rname = f"{self.run_name}-round-{i}"
-            # exchange the per-rank stage models so TRAIN metrics are
-            # computed against the same bagged ensemble the valid metrics
-            # (and the shipped checkpoint) use — local-model train metrics
-            # would shift with num_workers for identical params
-            models = allreduce(
-                self.model, name=f"{rname}-models", rank=self.rank,
-                world_size=self.world, reduce_fn=list, timeout=3600.0,
+            self.model.fit_one_round()
+            sums = self.model.local_metric_sums()
+            keys = sorted(sums)
+            merged_arr = self.comm.allreduce_sum(
+                np.array([sums[k] for k in keys]), f"metrics-{i}"
             )
-            if self.is_classif:
-                p = np.mean([m.predict_proba(self.X)[:, 1] for m in models], axis=0)
-                sums = {
-                    "n": float(n),
-                    "ll_sum": _logloss(self.y, p) * n,
-                    "err_sum": float(np.sum((p > 0.5) != self.y)),
-                }
-                valid_local = (
-                    self.model.predict_proba(self.Xv)[:, 1]
-                    if self.Xv is not None else None
-                )
-            else:
-                pred = np.mean([m.predict(self.X) for m in models], axis=0)
-                sums = {
-                    "n": float(n),
-                    "se_sum": float(np.sum((pred - self.y) ** 2)),
-                }
-                valid_local = (
-                    self.model.predict(self.Xv) if self.Xv is not None else None
-                )
-
-            def merge(vals):
-                return {k: np.sum([v[k] for v in vals], axis=0) for k in vals[0]}
-
-            # generous rendezvous deadline: one rank's fit on a big shard can
-            # take minutes, and a timeout here aborts training that the
-            # single-process path would complete
-            merged = allreduce(
-                sums, name=rname, rank=self.rank, world_size=self.world,
-                reduce_fn=merge, timeout=3600.0,
-            )
-            # validation predictions are large and only rank 0 consumes them:
-            # gather (O(N) store reads) instead of allreduce (O(N^2))
-            vlist = gather(
-                valid_local, name=rname, rank=self.rank,
-                world_size=self.world, dst=0, timeout=3600.0,
-            )
+            # the round's collective store keys ride along in the return so
+            # the trial loop can delete them without another (blockable)
+            # actor round-trip; every rank reports the same names
+            used = self.comm.drain_store_keys()
             if self.rank != 0:
-                return None
-            # rank 0 turns merged sums into the reference's metric names
-            metrics: Dict[str, Any] = {"iteration": i}
-            have_valid = vlist is not None and vlist[0] is not None
-            if self.is_classif:
-                metrics["train-logloss"] = float(merged["ll_sum"] / merged["n"])
-                metrics["train-error"] = float(merged["err_sum"] / merged["n"])
-                if have_valid:
-                    pv = np.sum(vlist, axis=0) / self.world  # bagged mean proba
-                    metrics["valid-error"] = float(np.mean((pv > 0.5) != self.yv))
-                    metrics["valid-logloss"] = _logloss(self.yv, pv)
-            else:
-                metrics["train-rmse"] = float(np.sqrt(merged["se_sum"] / merged["n"]))
-                if have_valid:
-                    pv = np.sum(vlist, axis=0) / self.world
-                    metrics["valid-rmse"] = float(np.sqrt(np.mean((pv - self.yv) ** 2)))
-            return metrics
+                return {"metrics": None, "used_keys": used}
+            merged = dict(zip(keys, merged_arr))
+            metrics = _hist_metrics_from_sums(merged, self.is_classif, i)
+            # every rank's model is identical — rank 0 scores validation
+            metrics.update(
+                _valid_metrics(self.model, self.Xv, self.yv, self.is_classif)
+            )
+            return {"metrics": metrics, "used_keys": used}
 
         def get_model(self):
-            return self.model
+            return self.model.scoring_copy()
+
+        def get_signature(self):
+            return self.model.signature()
 
     return _GBDTWorker
 
@@ -274,13 +312,14 @@ def _make_gbdt_worker_cls():
 def _distributed_gbdt_loop(config, world, label_column, num_boost_round,
                            objective, is_classif) -> None:
     """ScalingConfig(num_workers=N) path: N worker actors, each seeing ONLY
-    its row shard; per-round merged metrics; bagged merged model in the
-    checkpoint (VERDICT r2 missing 4; reference trains 5 rabit workers)."""
+    its row shard, growing IDENTICAL trees from allreduce-merged histograms
+    (rabit semantics — VERDICT r3 weak #4; reference trains 5 rabit
+    workers).  Rank identity is asserted at every checkpoint round, so
+    divergence is a hard training error, not silent skew."""
     import tpu_air
     from tpu_air.train import session
 
     params = dict(config.get("params", {}))
-    sk_params = _sk_params(params, num_boost_round)
 
     train_ds = session.get_dataset_shard("train")
     valid_ds = session.get_dataset_shard("valid")
@@ -303,24 +342,35 @@ def _distributed_gbdt_loop(config, world, label_column, num_boost_round,
     worker_cls = _make_gbdt_worker_cls().options(num_cpus=0)
     workers = [
         worker_cls.remote(
-            r, world, shards[r], valid_ds, label_column, sk_params,
-            is_classif, run_name,
+            r, world, shards[r],
+            valid_ds if r == 0 else None,  # only rank 0 scores validation
+            label_column, params, objective, is_classif, run_name,
         )
         for r in range(world)
     ]
 
     def ckpt(metrics, i):
-        models = tpu_air.get([w.get_model.remote() for w in workers])
+        # every rank holds the identical booster — assert it (cheap hash),
+        # then ship rank 0's
+        sigs = tpu_air.get([w.get_signature.remote() for w in workers])
+        if len(set(sigs)) != 1:
+            raise RuntimeError(
+                "distributed GBDT ranks diverged — allreduced histograms "
+                "should make every rank's booster bit-identical"
+            )
+        metrics["ranks_identical"] = True
+        model = tpu_air.get(workers[0].get_model.remote())
         return Checkpoint.from_model(
             preprocessor=preprocessor,
             metrics=metrics,
             extras={
-                "sklearn_model": BaggedGBDT(models, is_classif),
+                "sklearn_model": model,
                 "label_column": label_column,
                 "feature_columns": feature_columns,
                 "objective": objective,
                 "rounds_fit": int(i),
                 "num_workers": world,
+                "backend": "hist",
             },
         )
 
@@ -328,29 +378,23 @@ def _distributed_gbdt_loop(config, world, label_column, num_boost_round,
 
     store = _rt.current_worker().store if _rt.current_worker() else _rt.get_runtime().store
 
-    def cleanup_round(i):
-        # all ranks have returned from round i's allreduce once the futures
-        # resolve, so its rendezvous keys (incl. per-round proba arrays) can
-        # be deleted — otherwise they accumulate for the driver's lifetime
-        for r in range(world):
-            for key in (f"ar-{run_name}-round-{i}-{r}",
-                        f"ar-{run_name}-round-{i}-models-{r}",
-                        f"g-{run_name}-round-{i}-{r}"):
-                try:
-                    store.delete(key)
-                except Exception:
-                    pass
+    def delete_keys(keys):
+        # all ranks have returned from the round's collectives (the futures
+        # resolved), so the rendezvous keys can be deleted — otherwise they
+        # accumulate for the driver's lifetime.  On a crashed-rank round no
+        # keys are returned; that one round's payloads leak (bounded) rather
+        # than stalling the error path behind another actor round-trip.
+        for key in set(keys):
+            try:
+                store.delete(key)
+            except Exception:
+                pass
 
     try:
         for i in range(1, num_boost_round + 1):
-            try:
-                outs = tpu_air.get([w.fit_round.remote(i) for w in workers])
-            finally:
-                # also on the error path: a crashed rank must not strand the
-                # round's rendezvous payloads (incl. full validation-sized
-                # arrays) in the store for the driver's lifetime
-                cleanup_round(i)
-            metrics = outs[0]
+            outs = tpu_air.get([w.fit_round.remote(i) for w in workers])
+            delete_keys([k for o in outs for k in o["used_keys"]])
+            metrics = outs[0]["metrics"]
             stride = max(1, num_boost_round // 20)
             want_ckpt = (i % stride == 0) or (i == num_boost_round)
             session.report(metrics, checkpoint=ckpt(metrics, i) if want_ckpt else None)
