@@ -147,15 +147,23 @@ def test_viterbi_prefers_higher_score_segmentation():
     assert any(p not in sp.piece_to_id for p in pieces)
 
 
-@pytest.mark.skipif(
-    not any(
-        os.path.exists(os.path.join(d, "tokenizer.json"))
-        for d in [os.environ.get("FLAN_T5_TOKENIZER_DIR", "/nonexistent")]
-    ),
-    reason="real FLAN-T5 tokenizer assets not present offline",
-)
+def _real_asset_dir():
+    """Genuine FLAN-T5 tokenizer dir when present, else the vendored tiny
+    real-format asset (trained by the in-repo EM trainer) — the parity test
+    always runs."""
+    d = os.environ.get("FLAN_T5_TOKENIZER_DIR")
+    if d and os.path.exists(os.path.join(d, "tokenizer.json")):
+        return d
+    vendored = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "assets", "flan_t5_tiny"
+    )
+    return vendored if os.path.exists(os.path.join(vendored, "tokenizer.json")) else None
+
+
+@pytest.mark.skipif(_real_asset_dir() is None,
+                    reason="no tokenizer.json asset present")
 def test_real_flan_t5_parity():
-    d = os.environ["FLAN_T5_TOKENIZER_DIR"]
+    d = _real_asset_dir()
     from tokenizers import Tokenizer
 
     rust = Tokenizer.from_file(os.path.join(d, "tokenizer.json"))

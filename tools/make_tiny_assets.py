@@ -1,0 +1,100 @@
+"""Generate the vendored tiny real-format assets (tests/assets/flan_t5_tiny).
+
+Everything is produced OFFLINE from in-repo material:
+
+* ``spiece.model`` — a REAL unigram sentencepiece model (wire format)
+  TRAINED by the in-repo EM trainer (models/sentencepiece_unigram.py
+  train_unigram) on this repository's own documentation as the corpus;
+* ``tokenizer.json`` — the same vocabulary exported through the Rust
+  ``tokenizers`` library (the HF fast-tokenizer format), used as the
+  cross-implementation Viterbi parity oracle;
+* ``config.json`` + ``model.safetensors`` — a tiny REAL HF T5 checkpoint
+  written by ``transformers`` itself (deterministic seed), exercising the
+  true ``load_t5_from_hf`` import path;
+* ``asset_meta.json`` — expectations the asset-tier tests read (min vocab,
+  probe words, min params), so the same tests scale up to the genuine
+  flan-t5-small assets when those are present.
+
+Rerun with:  python tools/make_tiny_assets.py
+"""
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "tests", "assets", "flan_t5_tiny")
+
+VOCAB = 1024
+EXTRA_IDS = 16
+
+
+def corpus():
+    texts = []
+    for pattern in ("docs/*.md", "README.md", "SURVEY.md"):
+        for p in sorted(glob.glob(os.path.join(REPO, pattern))):
+            with open(p) as f:
+                texts.append(f.read())
+    return texts
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    from tpu_air.models.sentencepiece_unigram import train_t5_tokenizer
+
+    tok = train_t5_tokenizer(corpus(), vocab_size=VOCAB, extra_ids=EXTRA_IDS)
+    tok.save_pretrained(OUT)
+    print(f"spiece.model: {tok.vocab_size} ids "
+          f"({os.path.getsize(os.path.join(OUT, 'spiece.model'))} bytes)")
+
+    # Rust-format export: the parity oracle file
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    sp = tok.sp
+    vocab = [(p, s) for p, s, _ in sp.pieces]
+    vocab += [(f"<extra_id_{i}>", 0.0)
+              for i in reversed(range(EXTRA_IDS))]  # HF order: id_15 first
+    rust = Tokenizer(models.Unigram(vocab, unk_id=sp.unk_id,
+                                    byte_fallback=False))
+    rust.pre_tokenizer = pre_tokenizers.Metaspace(
+        replacement="▁", prepend_scheme="first", split=False
+    )
+    rust.save(os.path.join(OUT, "tokenizer.json"))
+    print("tokenizer.json written")
+
+    # tiny real HF T5 checkpoint (transformers' own save path)
+    import torch
+    import transformers
+
+    torch.manual_seed(0)
+    cfg = transformers.T5Config(
+        vocab_size=tok.vocab_size,
+        d_model=64, d_kv=16, d_ff=128,
+        num_layers=2, num_decoder_layers=2, num_heads=4,
+        relative_attention_num_buckets=8,
+        feed_forward_proj="gated-gelu",
+        tie_word_embeddings=False,
+        pad_token_id=0, eos_token_id=1, decoder_start_token_id=0,
+    )
+    model = transformers.T5ForConditionalGeneration(cfg)
+    model.save_pretrained(OUT)
+    n = sum(p.numel() for p in model.parameters())
+    print(f"checkpoint written: {n} params")
+
+    meta = {
+        "min_vocab": tok.vocab_size,
+        "min_params": int(n),
+        # words guaranteed segmentable+round-trippable (they appear in the
+        # training corpus)
+        "probe_text": "the framework trains the model over the device mesh",
+        "probe_words": ["framework", "model", "mesh"],
+    }
+    with open(os.path.join(OUT, "asset_meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    print("asset_meta.json written")
+
+
+if __name__ == "__main__":
+    main()

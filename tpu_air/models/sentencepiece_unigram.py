@@ -444,3 +444,151 @@ class T5SentencePieceTokenizer:
                 },
                 f,
             )
+
+
+# ---------------------------------------------------------------------------
+# unigram training (EM) — produce REAL .model assets without the spm wheel
+# ---------------------------------------------------------------------------
+
+def train_unigram(
+    texts: List[str],
+    vocab_size: int = 2048,
+    max_piece_len: int = 8,
+    seed_factor: int = 8,
+    em_iters: int = 2,
+    shrink_factor: float = 0.75,
+) -> List[Tuple[str, float, int]]:
+    """Train a unigram-LM piece vocabulary (the sentencepiece algorithm,
+    simplified): seed with frequent substrings of ▁-escaped words, run EM
+    (forward-backward expected counts over each word's segmentation
+    lattice), and iteratively prune low-count pieces until ``vocab_size``
+    NORMAL pieces remain — single characters are never pruned (full
+    character coverage, like spm's required_chars).  Returns
+    ``(piece, log-prob score, type)`` rows ready for
+    :func:`serialize_model_proto`.
+    """
+    import math
+    from collections import Counter
+
+    # ▁-escaped word counts (the training view of the corpus)
+    words: Counter = Counter()
+    for text in texts:
+        text = unicodedata.normalize("NFKC", text)
+        for w in text.split():
+            words[SPIECE_UNDERLINE + w] += 1
+
+    chars: Counter = Counter()
+    for w, f in words.items():
+        for ch in w:
+            chars[ch] += f
+    required = set(chars)
+
+    # seed: frequent substrings, scored by count * len (spm's seed heuristic)
+    subs: Counter = Counter()
+    for w, f in words.items():
+        L = len(w)
+        for i in range(L):
+            for ln in range(2, min(max_piece_len, L - i) + 1):
+                subs[w[i : i + ln]] += f
+    seed_n = max(seed_factor * vocab_size, vocab_size + len(required))
+    seeded = [
+        s for s, c in sorted(
+            subs.items(), key=lambda kv: (-kv[1] * len(kv[0]), kv[0])
+        )[:seed_n]
+    ]
+    vocab = {p: float(subs[p] * len(p)) for p in seeded}
+    for ch in required:
+        vocab[ch] = float(max(chars[ch], 1))
+
+    def em_round(vocab):
+        total = sum(vocab.values())
+        logp = {p: math.log(c / total) for p, c in vocab.items()}
+        maxlen = max(len(p) for p in logp)
+        counts: Counter = Counter()
+        for w, f in words.items():
+            n = len(w)
+            # forward
+            alpha = [-1e30] * (n + 1)
+            alpha[0] = 0.0
+            arcs = [[] for _ in range(n + 1)]  # arcs[end] = [(start, piece, lp)]
+            for i in range(n):
+                if alpha[i] <= -1e29:
+                    continue
+                for ln in range(1, min(maxlen, n - i) + 1):
+                    sub = w[i : i + ln]
+                    lp = logp.get(sub)
+                    if lp is None:
+                        continue
+                    arcs[i + ln].append((i, sub, lp))
+                    cand = alpha[i] + lp
+                    a = alpha[i + ln]
+                    m = cand if cand > a else a
+                    alpha[i + ln] = m + math.log1p(math.exp(-abs(cand - a))) \
+                        if a > -1e29 else cand
+            if alpha[n] <= -1e29:
+                continue  # unreachable (cannot happen: chars are in vocab)
+            # backward
+            beta = [-1e30] * (n + 1)
+            beta[n] = 0.0
+            for end in range(n, 0, -1):
+                if beta[end] <= -1e29:
+                    continue
+                for i, sub, lp in arcs[end]:
+                    cand = beta[end] + lp
+                    b = beta[i]
+                    m = cand if cand > b else b
+                    beta[i] = m + math.log1p(math.exp(-abs(cand - b))) \
+                        if b > -1e29 else cand
+            z = alpha[n]
+            for end in range(1, n + 1):
+                for i, sub, lp in arcs[end]:
+                    post = alpha[i] + lp + beta[end] - z
+                    if post > -30.0:
+                        counts[sub] += f * math.exp(post)
+        return counts
+
+    while True:
+        for _ in range(em_iters):
+            counts = em_round(vocab)
+            vocab = {
+                p: max(counts.get(p, 0.0), 1e-6 if p in required else 0.0)
+                for p in vocab
+            }
+            vocab = {p: c for p, c in vocab.items() if c > 0.0}
+        n_prunable = len(vocab)
+        if n_prunable <= vocab_size:
+            break
+        keep = max(vocab_size, int(n_prunable * shrink_factor))
+        ranked = sorted(vocab.items(), key=lambda kv: (-kv[1], kv[0]))
+        kept = {p: c for p, c in ranked[:keep]}
+        for ch in required:  # coverage is non-negotiable
+            kept.setdefault(ch, vocab.get(ch, 1e-6))
+        if len(kept) == len(vocab):
+            break  # nothing prunable left beyond required chars
+        vocab = kept
+
+    total = sum(vocab.values())
+    import math as _m
+
+    scored = sorted(
+        ((p, _m.log(c / total)) for p, c in vocab.items()),
+        key=lambda kv: (-kv[1], kv[0]),
+    )
+    return [(p, s, _NORMAL) for p, s in scored]
+
+
+def train_t5_tokenizer(
+    texts: List[str], vocab_size: int = 2048, model_max_length: int = 512,
+    extra_ids: int = 100, **train_kwargs,
+) -> "T5SentencePieceTokenizer":
+    """Train and wrap with the T5 id layout (pad=0, eos=1, unk=2)."""
+    normal = train_unigram(texts, vocab_size=vocab_size, **train_kwargs)
+    pieces = [
+        ("<pad>", 0.0, _CONTROL),
+        ("</s>", 0.0, _CONTROL),
+        ("<unk>", 0.0, _UNKNOWN),
+    ] + normal
+    return T5SentencePieceTokenizer(
+        SentencePieceUnigram(pieces), model_max_length=model_max_length,
+        extra_ids=extra_ids,
+    )
