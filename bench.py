@@ -670,7 +670,8 @@ def main() -> None:
     # final fallback: CPU smoke with the TPU plugin disabled — only when the
     # whole round saw no valid TPU measurement; record exactly why
     if not result:
-        result, note = _run_child(_cpu_env(), timeout=900)
+        cpu_timeout = float(os.environ.get("TPU_AIR_BENCH_CPU_TIMEOUT", "900"))
+        result, note = _run_child(_cpu_env(), timeout=cpu_timeout)
         if result:
             result["fallback_reason"] = {
                 "note": "TPU backend unavailable and no valid TPU measurement "

@@ -142,7 +142,7 @@ def phase_d_trainer_spans_hosts():
         })
 
     rows = [{"instruction": f"say w{i % 5}", "output": f"w{i % 5}"}
-            for i in range(32)]
+            for i in range(16)]
     trainer = T5Trainer(
         model_config=T5Config.tiny(vocab_size=384),
         training_args=TrainingArguments(

@@ -31,6 +31,16 @@ def _want_env() -> dict:
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": xla,
         "TPU_AIR_NUM_CHIPS": os.environ.get("TPU_AIR_NUM_CHIPS", "8"),
+        # persistent XLA compilation cache: many tests (and their worker
+        # subprocesses, which inherit the env) compile identical tiny-model
+        # steps — cache hits cut the single-core suite time substantially,
+        # and repeat runs even more
+        "JAX_COMPILATION_CACHE_DIR": os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", "/var/tmp/tpu_air-xla-test-cache"
+        ),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": os.environ.get(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5"
+        ),
     }
 
 

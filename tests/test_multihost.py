@@ -2,6 +2,7 @@
 hard-part 3): 2-process jax.distributed rendezvous on virtual CPU devices,
 per-host agent control plane, one cross-process psum train step."""
 
+import pytest
 import os
 import subprocess
 import sys
@@ -9,6 +10,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow  # numerics-parity / superseded-coverage: slow tier (budget, r3 weak #5)
 def test_two_process_psum_train_step():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)

@@ -124,6 +124,7 @@ def test_ring_attention_is_actually_sharded(qkv):
     )
 
 
+@pytest.mark.slow  # numerics-parity / superseded-coverage: slow tier (budget, r3 weak #5)
 def test_t5_flash_config_path_matches_einsum():
     """config.use_flash_attention swaps the attention impl without changing
     the math — parity through the full T5 stack."""

@@ -66,6 +66,7 @@ def test_generate_greedy_jit(tiny):
     np.testing.assert_array_equal(out, out2)
 
 
+@pytest.mark.slow  # numerics-parity / superseded-coverage: slow tier (budget, r3 weak #5)
 def test_generate_incremental_matches_full_forward(tiny):
     """The KV-cache decode must agree with the non-cached forward pass:
     greedy tokens from generate == argmax chain from full forwards."""

@@ -250,6 +250,7 @@ def test_tensor_parallel_trainer(air):
     assert np.isfinite(m["loss"])
 
 
+@pytest.mark.slow  # numerics-parity / superseded-coverage: slow tier (budget, r3 weak #5)
 def test_tensor_parallel_matches_dp_loss(air):
     """One tp=2 epoch and one pure-DP epoch from the same init produce the
     same loss trajectory (TP is a layout change, not a math change)."""

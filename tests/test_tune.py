@@ -362,6 +362,7 @@ def test_user_training_iteration_does_not_stall_stream(air):
 
 # -- long-context LM sweep over sub-mesh leases -------------------------------
 
+@pytest.mark.slow  # numerics-parity / superseded-coverage: slow tier (budget, r3 weak #5)
 def test_tuner_over_lm_trainer_sequence_parallel(air):
     """Trial-parallel HPO composes with the long-context trainer: each trial
     leases a dp x sp sub-mesh (ScalingConfig(sequence_parallel=2)) and runs
