@@ -152,11 +152,28 @@ def _measure_slope(model, config, params0, batch, enc_len, dec_len, steps_short,
     steps_long = 3 * steps_short
     params = jax.tree_util.tree_map(jnp.copy, params0)
     opt_state = tx.init(params)
+    out = _slope_core(make_run, (params, opt_state), steps_short, reps)
+    tokens_per_step = batch * (enc_len + dec_len)
+    per_step = out["per_step_s"]
+    out["tokens_per_sec"] = (
+        tokens_per_step / per_step if per_step == per_step and per_step > 0 else 0.0
+    )
+    return out
 
-    # AOT-compile both scan lengths once; the compiled executables are used
-    # for the timed calls AND for XLA's own FLOP count of the measured program
-    run_short = make_run(steps_short).lower(params, opt_state).compile()
-    run_long = make_run(steps_long).lower(params, opt_state).compile()
+
+def _slope_core(make_run, state0, steps_short, reps=3):
+    """Shared slope-timing engine: AOT-compile an N-step and a 3N-step scan,
+    time both, take per-step from the delta (fixed sync/dispatch costs
+    cancel), gate validity, and disambiguate XLA's scan FLOP accounting.
+
+    ``make_run(steps)`` must return a jittable ``f(*state) -> (*state',
+    checksum)`` whose checksum is data-dependent on the FULL final state (a
+    real device sync).  State is threaded through donation."""
+    steps_long = 3 * steps_short
+    state = state0
+
+    run_short = make_run(steps_short).lower(*state).compile()
+    run_long = make_run(steps_long).lower(*state).compile()
 
     # XLA's cost model on TPU counts a lax.scan body ONCE regardless of trip
     # count (verified empirically: an N=4 and an N=12 scan of the same matmul
@@ -175,21 +192,22 @@ def _measure_slope(model, config, params0, batch, enc_len, dec_len, steps_short,
             flops_per_step = total_long
             flops_source_detail = "xla_cost_analysis_body_once"
 
-    def timed(run, p, o):
+    def timed(run, state):
         t0 = time.perf_counter()
-        p, o, checksum = run(p, o)
-        loss = float(checksum)  # host transfer of full-tree-dependent scalar
-        return time.perf_counter() - t0, loss, p, o
+        out = run(*state)
+        state, checksum = out[:-1], out[-1]
+        loss = float(checksum)  # host transfer of full-state-dependent scalar
+        return time.perf_counter() - t0, loss, state
 
     # compile + warm both programs (donation threads state through each call)
-    _, _, params, opt_state = timed(run_short, params, opt_state)
-    _, _, params, opt_state = timed(run_long, params, opt_state)
+    _, _, state = timed(run_short, state)
+    _, _, state = timed(run_long, state)
 
     t_short, t_long, loss = [], [], 0.0
     for _ in range(reps):
-        dt, loss, params, opt_state = timed(run_short, params, opt_state)
+        dt, loss, state = timed(run_short, state)
         t_short.append(dt)
-        dt, loss, params, opt_state = timed(run_long, params, opt_state)
+        dt, loss, state = timed(run_long, state)
         t_long.append(dt)
 
     med_short = sorted(t_short)[len(t_short) // 2]
@@ -209,11 +227,7 @@ def _measure_slope(model, config, params0, batch, enc_len, dec_len, steps_short,
             f"negative implied overhead ({implied_overhead:.4f}s) exceeds noise band"
         )
 
-    tokens_per_step = batch * (enc_len + dec_len)
-    tokens_per_sec = tokens_per_step / per_step if per_step == per_step and per_step > 0 else 0.0
-
     return {
-        "tokens_per_sec": tokens_per_sec,
         "per_step_s": per_step,
         "t_short_s": [round(t, 4) for t in t_short],
         "t_long_s": [round(t, 4) for t in t_long],
@@ -224,6 +238,232 @@ def _measure_slope(model, config, params0, batch, enc_len, dec_len, steps_short,
         "problems": problems,
         "final_loss": loss,
     }
+
+
+def _measure_segformer(batch=32, img=512, steps_short=4, on_tpu=True):
+    """W6: SegFormer-B0 (mit-b0) fine-tune throughput, images/sec/chip + MFU
+    (Scaling_model_training.ipynb:cc-52 trains 512x512 ADE20K) — same slope
+    machinery and validity gates as the T5 section (BASELINE.md TBD row)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from functools import partial
+
+    from tpu_air.models.segformer import (
+        SegformerConfig,
+        SegformerForSemanticSegmentation,
+        segmentation_loss,
+    )
+
+    config = SegformerConfig()  # defaults are mit-b0
+    config.dtype = "bfloat16" if on_tpu else "float32"
+    config.drop_path_rate = 0.0
+    config.classifier_dropout_prob = 0.0
+    model = SegformerForSemanticSegmentation(config)
+
+    rng = jax.random.PRNGKey(0)
+    px = jax.random.normal(rng, (batch, img, img, 3), jnp.float32)
+    lb = jax.random.randint(rng, (batch, img // 4, img // 4), 0,
+                            config.num_labels, jnp.int32)
+    init = model.init(rng, jnp.zeros((1, img, img, 3)))
+    params, bstats = init["params"], init.get("batch_stats", {})
+    n_params = _count_params(params)
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    def train_step(carry, _):
+        p, bs, o = carry
+
+        def lf(pp):
+            logits, upd = model.apply(
+                {"params": pp, "batch_stats": bs}, px,
+                deterministic=True, mutable=["batch_stats"],
+            )
+            return segmentation_loss(logits, lb, config.semantic_loss_ignore_index), upd["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(lf, has_aux=True)(p)
+        updates, o = tx.update(grads, o, p)
+        return (optax.apply_updates(p, updates), new_bs, o), loss
+
+    def make_run(steps):
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def run(p, bs, o):
+            (p, bs, o), losses = jax.lax.scan(
+                train_step, (p, bs, o), None, length=steps
+            )
+            checksum = losses[-1] + jnp.asarray(1e-20, losses.dtype) * (
+                optax.global_norm(p)
+            )
+            return p, bs, o, checksum
+
+        return run
+
+    out = _slope_core(make_run, (params, bstats, opt_state), steps_short)
+    per_step = out["per_step_s"]
+    images_per_sec = batch / per_step if per_step == per_step and per_step > 0 else 0.0
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev.device_kind) if on_tpu else None
+    mfu = (
+        out["flops_per_step_xla"] / per_step / peak
+        if peak and out["flops_per_step_xla"] and per_step > 0
+        else None
+    )
+    problems = list(out["problems"])
+    if mfu is not None and not (0.0 < mfu <= 1.0):
+        problems.append(f"segformer mfu={mfu:.4f} outside (0, 1]")
+    if not math.isfinite(out["final_loss"]):
+        problems.append("segformer final loss non-finite")
+    return {
+        "model": "segformer-b0",
+        "batch": batch,
+        "image_size": img,
+        "n_params": n_params,
+        "images_per_sec": round(images_per_sec, 2),
+        "per_step_s": round(per_step, 5) if per_step == per_step else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_step_xla": out["flops_per_step_xla"],
+        "flops_xla_detail": out["flops_xla_detail"],
+        "timing": {k: out[k] for k in ("t_short_s", "t_long_s", "steps",
+                                       "implied_overhead_s")},
+        "measurement_valid": not problems,
+        "problems": problems,
+        "final_loss": round(out["final_loss"], 4)
+        if math.isfinite(out["final_loss"]) else None,
+    }
+
+
+def _parse_xplane_top_ops(trace_dir: str, steps: int, top_k: int = 5):
+    """Parse the xplane trace into per-step top op-groups (device plane).
+
+    Returns {plane, device_total_ms_per_step, top_ops: [{name, ms_per_step,
+    fraction_of_device}]} for the busiest device plane — the 'where does
+    the other half of MFU go' evidence (VERDICT r3 weak #3)."""
+    import glob as _glob
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore
+
+    paths = sorted(
+        _glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
+    )
+    if not paths:
+        return {"error": "no xplane.pb produced"}
+    space = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        space.ParseFromString(f.read())
+    def tally(plane):
+        md = {k: v.name or v.display_name for k, v in plane.event_metadata.items()}
+        totals: dict = {}
+        busy_ps = 0
+        for line in plane.lines:
+            for ev in line.events:
+                name = md.get(ev.metadata_id, f"op_{ev.metadata_id}")
+                totals[name] = totals.get(name, 0) + ev.duration_ps
+                busy_ps += ev.duration_ps
+        return busy_ps, totals
+
+    best = None
+    device_planes = [
+        p for p in space.planes
+        if p.name.startswith("/device:") or "TPU" in p.name
+    ]
+    # the TPU device plane is the target; CPU traces put XLA ops elsewhere —
+    # fall back to the busiest plane so the smoke path stays exercised
+    for plane in device_planes or space.planes:
+        busy_ps, totals = tally(plane)
+        if totals and (best is None or busy_ps > best[0]):
+            best = (busy_ps, plane.name, totals)
+    if best is None:
+        return {"error": "no plane with events in trace"}
+    busy_ps, plane_name, totals = best
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1])[:top_k]
+    is_device = plane_name.startswith("/device:") or "TPU" in plane_name
+    return {
+        **(
+            {}
+            if is_device
+            else {"note": "host-plane fallback (no device plane in trace) — "
+                          "op attribution is only meaningful on TPU"}
+        ),
+        "plane": plane_name,
+        "device_total_ms_per_step": round(busy_ps / 1e9 / steps, 3),
+        "top_ops": [
+            {
+                "name": n[:120],
+                "ms_per_step": round(d / 1e9 / steps, 3),
+                "fraction_of_device": round(d / busy_ps, 3),
+            }
+            for n, d in ranked
+        ],
+    }
+
+
+def _measure_mfu_breakdown(model, config, params, batch, enc_len, dec_len,
+                           steps=6):
+    """Profile the W1 train step with the JAX profiler and attribute device
+    time to the top ops, plus the device-busy fraction of wall time (the
+    host/dispatch gap).  Wired through observability/profiler.py."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_air.models.t5 import cross_entropy_loss, shift_right
+    from tpu_air.observability.profiler import profile_trace
+
+    pad, start = config.pad_token_id, config.decoder_start_token_id
+    rng = jax.random.PRNGKey(0)
+    input_ids = jax.random.randint(rng, (batch, enc_len), 2, config.vocab_size,
+                                   jnp.int32)
+    attention_mask = jnp.ones((batch, enc_len), jnp.int32)
+    labels = jax.random.randint(rng, (batch, dec_len), 2, config.vocab_size,
+                                jnp.int32)
+    tx = optax.chain(optax.clip_by_global_norm(1.0),
+                     optax.adamw(2e-5, weight_decay=0.01))
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, o):
+        def loss_fn(pp):
+            dec_in = shift_right(labels, start, pad)
+            dec_mask = (dec_in != pad).astype(jnp.int32).at[:, 0].set(1)
+            logits = model.apply(
+                {"params": pp}, input_ids, attention_mask, dec_in,
+                decoder_attention_mask=dec_mask, deterministic=True,
+            )
+            loss, _ = cross_entropy_loss(logits, labels, pad)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    params = jax.tree_util.tree_map(jnp.copy, params)
+    opt_state = tx.init(params)
+    # warm/compile outside the trace
+    params, opt_state, loss = train_step(params, opt_state)
+    float(loss)
+
+    trace_dir = tempfile.mkdtemp(prefix="tpu_air-bench-xplane-")
+    try:
+        t0 = time.perf_counter()
+        with profile_trace(trace_dir):
+            for _ in range(steps):
+                params, opt_state, loss = train_step(params, opt_state)
+            wall = None
+            float(loss)  # sync inside the trace window
+        wall = time.perf_counter() - t0
+        out = _parse_xplane_top_ops(trace_dir, steps)
+        out["wall_ms_per_step"] = round(wall / steps * 1e3, 3)
+        if "device_total_ms_per_step" in out:
+            out["device_busy_fraction_of_wall"] = round(
+                out["device_total_ms_per_step"] / out["wall_ms_per_step"], 3
+            )
+        return out
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)
 
 
 def _med3(fn) -> float:
@@ -439,6 +679,8 @@ def _child_main() -> None:
 
     long_context = long_context_error = None
     generation = generation_error = None
+    segformer = segformer_error = None
+    mfu_breakdown = None
     if on_tpu:
         try:
             long_context = _measure_long_context_attention()
@@ -451,6 +693,33 @@ def _child_main() -> None:
         except Exception as e:  # noqa: BLE001 — visible, never fatal
             generation_error = f"{type(e).__name__}: {e}"
             print(f"generation bench failed: {generation_error}", file=sys.stderr)
+        try:
+            segformer = _measure_segformer(batch=32, img=512, on_tpu=True)
+        except Exception as e:  # noqa: BLE001 — visible, never fatal
+            segformer_error = f"{type(e).__name__}: {e}"
+            print(f"segformer bench failed: {segformer_error}", file=sys.stderr)
+        try:
+            mfu_breakdown = _measure_mfu_breakdown(
+                model, config, params, batch, enc_len, dec_len
+            )
+        except Exception as e:  # noqa: BLE001 — visible, never fatal
+            mfu_breakdown = {"error": f"{type(e).__name__}: {e}"}
+            print(f"mfu breakdown failed: {e}", file=sys.stderr)
+    else:
+        # CPU smoke keeps the sections' code paths exercised at tiny dials
+        try:
+            segformer = _measure_segformer(batch=2, img=64, steps_short=2,
+                                           on_tpu=False)
+        except Exception as e:  # noqa: BLE001
+            segformer_error = f"{type(e).__name__}: {e}"
+            print(f"segformer cpu smoke failed: {segformer_error}", file=sys.stderr)
+        try:
+            mfu_breakdown = _measure_mfu_breakdown(
+                model, config, params, batch, enc_len, dec_len, steps=2
+            )
+        except Exception as e:  # noqa: BLE001
+            mfu_breakdown = {"error": f"{type(e).__name__}: {e}"}
+            print(f"mfu breakdown cpu smoke failed: {e}", file=sys.stderr)
 
     valid_paths = {k: m for k, m in results.items() if not m["problems"]}
     pool = valid_paths or results
@@ -545,6 +814,12 @@ def _child_main() -> None:
         result["generation"] = generation
     if generation_error:
         result["generation_error"] = generation_error
+    if segformer is not None:
+        result["segformer"] = segformer
+    if segformer_error:
+        result["segformer_error"] = segformer_error
+    if mfu_breakdown is not None:
+        result["mfu_breakdown"] = mfu_breakdown
     print(json.dumps(result), flush=True)
 
 
