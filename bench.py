@@ -582,15 +582,16 @@ def _measure_generation(model, config, params, batch=256, enc_len=512,
     rng = jax.random.PRNGKey(0)
     ids = jax.random.randint(rng, (batch, enc_len), 2, config.vocab_size, jnp.int32)
     mask = jnp.ones((batch, enc_len), jnp.int32)
-    fn = make_generate_fn(model, max_new_tokens, False, 1.0, 0)
-    int(jnp.sum(fn(params, ids, mask, rng)))  # compile + warm
+    fn = make_generate_fn(model, max_new_tokens, False, 1.0, 0,
+                          early_stop=False)  # measure the FULL budget
+    int(jnp.sum(fn(params, ids, mask, rng)[0]))  # compile + warm
     # token checksum forces a real device sync per call
-    t1 = _med3(lambda: int(jnp.sum(fn(params, ids, mask, rng))))
+    t1 = _med3(lambda: int(jnp.sum(fn(params, ids, mask, rng)[0])))
     # slope sanity: two back-to-back calls; the marginal call must cost
     # about one call (a sync that lies shows up as marginal << single)
     t0 = time.perf_counter()
-    int(jnp.sum(fn(params, ids, mask, rng)))
-    int(jnp.sum(fn(params, ids, mask, rng)))
+    int(jnp.sum(fn(params, ids, mask, rng)[0]))
+    int(jnp.sum(fn(params, ids, mask, rng)[0]))
     marginal = (time.perf_counter() - t0) - t1
     valid = marginal > 0.5 * t1
     per = marginal if valid else t1
@@ -605,9 +606,10 @@ def _measure_generation(model, config, params, batch=256, enc_len=512,
     }
     try:
         half = max_new_tokens // 2
-        fn_half = make_generate_fn(model, half, False, 1.0, 0)
-        int(jnp.sum(fn_half(params, ids, mask, rng)))  # compile + warm
-        t_half = _med3(lambda: int(jnp.sum(fn_half(params, ids, mask, rng))))
+        fn_half = make_generate_fn(model, half, False, 1.0, 0,
+                                   early_stop=False)
+        int(jnp.sum(fn_half(params, ids, mask, rng)[0]))  # compile + warm
+        t_half = _med3(lambda: int(jnp.sum(fn_half(params, ids, mask, rng)[0])))
         step_s = (t1 - t_half) / (max_new_tokens - half)
         bytes_model = _decode_step_bytes(config, batch, enc_len,
                                          max_new_tokens + 1)
