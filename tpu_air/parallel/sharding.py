@@ -47,15 +47,31 @@ def _path_names(path) -> list:
     return out
 
 
-def t5_param_shardings(params, mesh) -> Any:
-    """NamedSharding tree for a T5 param tree over ``mesh`` (axes
-    ("data","model"); "model" may be absent → replication)."""
+def lm_param_spec(path_names, leaf) -> P:
+    """PartitionSpec for one causal-LM param (models/lm), by tree path:
+    attention q/k/v and SwiGLU gate/up shard their OUTPUT (heads / ff) dim
+    over ``model``; o/down shard their INPUT dim; embeddings and norms
+    replicate (the tied head reads the replicated embedding)."""
+    names = [str(p) for p in path_names]
+    leafname = names[-1]
+    parent = names[-2] if len(names) >= 2 else ""
+    if leafname == "kernel":
+        if parent in ("q", "k", "v", "gate", "up"):
+            return P(None, "model")
+        if parent in ("o", "down"):
+            return P("model", None)
+    return P()
+
+
+def _param_shardings(params, mesh, spec_fn) -> Any:
+    """NamedSharding tree over ``mesh`` from a path→spec rule (axes include
+    "model"; its absence → replication)."""
     has_model = "model" in mesh.axis_names
 
     def spec_for(path, leaf):
         if not has_model:
             return NamedSharding(mesh, P())
-        spec = t5_param_spec(_path_names(path), leaf)
+        spec = spec_fn(_path_names(path), leaf)
         # drop specs that don't divide evenly — XLA requires divisibility
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         ok = []
@@ -69,6 +85,17 @@ def t5_param_shardings(params, mesh) -> Any:
         return NamedSharding(mesh, P(*ok))
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def t5_param_shardings(params, mesh) -> Any:
+    """NamedSharding tree for a T5 param tree over ``mesh`` (axes
+    ("data","model"); "model" may be absent → replication)."""
+    return _param_shardings(params, mesh, t5_param_spec)
+
+
+def lm_param_shardings(params, mesh) -> Any:
+    """NamedSharding tree for a causal-LM param tree (models/lm)."""
+    return _param_shardings(params, mesh, lm_param_spec)
 
 
 def _place(x, sharding):
@@ -92,6 +119,6 @@ def replicate(tree, mesh):
     return jax.tree_util.tree_map(lambda x: _place(x, sh), tree)
 
 
-def shard_params(params, mesh):
-    shardings = t5_param_shardings(params, mesh)
+def shard_params(params, mesh, spec_fn=t5_param_spec):
+    shardings = _param_shardings(params, mesh, spec_fn)
     return jax.tree_util.tree_map(_place, params, shardings)
