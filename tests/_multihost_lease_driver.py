@@ -168,7 +168,55 @@ def phase_d_trainer_spans_hosts():
     print("PHASE-D-OK", flush=True)
 
 
+def phase_e_multihost_failure_retry(tmp_marker):
+    """FailureConfig on the SPMD-multihost path: a training error on the
+    first attempt retries from the latest checkpoint and succeeds."""
+    from tpu_air.train import (
+        Checkpoint,
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    def loop(config):
+        import os as _os
+
+        import jax
+
+        from tpu_air.train import session
+
+        start = 0
+        if config.get("resume_from_checkpoint"):
+            ck = Checkpoint.from_directory(config["resume_from_checkpoint"])
+            start = ck.get_metrics()["i"]
+        marker = config["marker"]
+        for i in range(start, 3):
+            ck = Checkpoint.from_model(metrics={"i": i + 1})
+            session.report(
+                {"i": i + 1, "nproc": jax.process_count()}, checkpoint=ck
+            )
+            if i == 0 and not _os.path.exists(marker):
+                if jax.process_index() == 0:
+                    with open(marker, "w") as f:
+                        f.write("crashed once")
+                raise RuntimeError("simulated multihost crash")
+
+    r = JaxTrainer(
+        loop,
+        train_loop_config={"marker": tmp_marker},
+        # 8 chips > chips_per_host -> the SPMD-multihost path
+        scaling_config=ScalingConfig(num_workers=8, num_chips_per_worker=1),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert r.error is None, r.error
+    assert r.metrics["i"] == 3 and r.metrics["nproc"] == 2, r.metrics
+    print("PHASE-E-OK", flush=True)
+
+
 def main() -> int:
+    import tempfile
+
     cluster = spawn_local_cluster(NPROC, CPH)
     try:
         import tpu_air
@@ -182,6 +230,9 @@ def main() -> int:
         phase_b_tune()
         phase_c_batch_predictor()
         phase_d_trainer_spans_hosts()
+        phase_e_multihost_failure_retry(
+            os.path.join(tempfile.mkdtemp(prefix="tpu_air-mh-"), "crash-marker")
+        )
         tpu_air.shutdown()
     finally:
         cluster.shutdown()

@@ -47,7 +47,7 @@ def test_cross_host_chip_leases():
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
     )
     for marker in ("PHASE-A-OK", "PHASE-B-OK", "PHASE-C-OK", "PHASE-D-OK",
-                   "MULTIHOST-LEASES-OK"):
+                   "PHASE-E-OK", "MULTIHOST-LEASES-OK"):
         assert marker in proc.stdout
 
 

@@ -270,26 +270,43 @@ class BaseTrainer:
         """Run the training fn on EVERY host of the active cluster in
         lockstep over a cross-host chip lease.  Host 0 (this process) keeps
         the real session (reporting, checkpoint retention); other hosts run
-        throwaway replicas whose only output is their error status.  One
-        attempt (no FailureConfig retry on this path yet — a host loss kills
-        the fit; resume_from_checkpoint still works on the next call)."""
+        throwaway replicas whose only output is their error status.
+
+        FailureConfig semantics match the actor path for TRAINING errors
+        (exceptions inside the training fn): retry from the latest
+        checkpoint up to ``max_failures``.  Infrastructure failures (a dead
+        host agent) propagate — the same dead cluster would fail every
+        retry."""
         sc = self.scaling_config
         rc = self.run_config
-        if resume is not None:
-            config["resume_from_checkpoint"] = (
-                resume.to_directory() if isinstance(resume, Checkpoint) else resume
-            )
-        lease = rt.lease_chips(sc.total_chips, timeout=300.0)
-        try:
-            return self._run_spmd_leased(
-                datasets, run_dir, config, cluster, rc, sc, lease
-            )
-        finally:
-            rt.release_chips(lease)
+        max_failures = rc.failure_config.max_failures
+        attempt = 0
+        while True:
+            if resume is not None:
+                config["resume_from_checkpoint"] = (
+                    resume.to_directory()
+                    if isinstance(resume, Checkpoint) else resume
+                )
+            lease = rt.lease_chips(sc.total_chips, timeout=300.0)
+            try:
+                out, error = self._run_spmd_leased(
+                    datasets, run_dir, config, cluster, rc, sc, lease
+                )
+            finally:
+                rt.release_chips(lease)
+            if error is None:
+                return self._assemble(out, run_dir, config, None)
+            latest = out.get("latest_checkpoint")
+            if attempt < max_failures:
+                attempt += 1
+                if latest:
+                    resume = Checkpoint.from_directory(latest[0])
+                continue
+            return self._assemble(out, run_dir, config, error)
 
-    def _run_spmd_leased(
-        self, datasets, run_dir, config, cluster, rc, sc, lease
-    ) -> Result:
+    def _run_spmd_leased(self, datasets, run_dir, config, cluster, rc, sc,
+                         lease):
+        """One multihost attempt; returns (host-0 out dict, error|None)."""
         training_fn = self._training_fn()
         dfs = {
             k: ds.to_pandas() for k, ds in datasets.items() if ds is not None
@@ -357,7 +374,7 @@ class BaseTrainer:
         out = outs[0]
         errors = [o["error"] for o in outs if o.get("error")]
         error = RuntimeError("\n---\n".join(errors)) if errors else None
-        return self._assemble(out, run_dir, config, error)
+        return out, error
 
     def _assemble(self, out, run_dir, config, error) -> Result:
         best = out.get("best_checkpoint")
