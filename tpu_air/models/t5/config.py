@@ -48,6 +48,14 @@ class T5Config:
     attention_impl: str = "auto"
     flash_min_seq_len: int = 1024
     use_flash_attention: bool = False
+    # Opt-in int8 cross-attention K/V cache for cached decode: the cross
+    # K/V are the dominant HBM term of every decode step (B x enc_len x
+    # n_heads x d_kv x 2 x layers, re-read per emitted token); storing them
+    # int8 with per-(batch, head, channel) scales halves that traffic at
+    # the cost of quantization error in the cross-attention scores.  Off by
+    # default — the reference decodes fp16 (cc-64); numerics parity is
+    # tested at tolerance in tests/test_t5.py.
+    decode_cache_int8: bool = False
 
     def __post_init__(self):
         if self.num_decoder_layers is None:

@@ -136,6 +136,16 @@ class Attention(nn.Module):
             )
 
         q = dense("q")(hidden)           # [b, q, h, d]
+        cache_int8 = getattr(cfg, "decode_cache_int8", False)
+
+        def _quant(x):
+            # per-(batch, head, channel) scale over the length dim: the
+            # length axis is what streams from HBM every step
+            amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1, keepdims=True)
+            scale = jnp.maximum(amax, 1e-8) / 127.0
+            q8 = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+            return q8.astype(jnp.int8), scale
+
         if cross_decode and self.has_variable("cache", "cached_key"):
             # Cross-attention during cached decode: K/V are an invariant of
             # the encoder output, computed ONCE at cache init.  Recomputing
@@ -144,12 +154,29 @@ class Attention(nn.Module):
             # encoder length, per emitted token).
             k = self.get_variable("cache", "cached_key")
             v = self.get_variable("cache", "cached_value")
+            if cache_int8:
+                k = (k.astype(jnp.float32)
+                     * self.get_variable("cache", "cached_key_scale")).astype(dtype)
+                v = (v.astype(jnp.float32)
+                     * self.get_variable("cache", "cached_value_scale")).astype(dtype)
         else:
             k = dense("k")(kv_hidden)    # [b, k, h, d]
             v = dense("v")(kv_hidden)
             if cross_decode:
-                self.variable("cache", "cached_key", lambda: k)
-                self.variable("cache", "cached_value", lambda: v)
+                if cache_int8:
+                    kq, ks = _quant(k)
+                    vq, vs = _quant(v)
+                    self.variable("cache", "cached_key", lambda: kq)
+                    self.variable("cache", "cached_key_scale", lambda: ks)
+                    self.variable("cache", "cached_value", lambda: vq)
+                    self.variable("cache", "cached_value_scale", lambda: vs)
+                    # the init pass itself attends with the dequantized
+                    # values so its output matches later steps
+                    k = (kq.astype(jnp.float32) * ks).astype(dtype)
+                    v = (vq.astype(jnp.float32) * vs).astype(dtype)
+                else:
+                    self.variable("cache", "cached_key", lambda: k)
+                    self.variable("cache", "cached_value", lambda: v)
 
         if decode:
             # Cache layout [b, max_len, h, d]; cache vars are created ahead of
