@@ -259,7 +259,9 @@ def test_int8_cross_kv_cache_numerics(tiny):
     )
     rng = jax.random.PRNGKey(1)
     ids = jax.random.randint(rng, (2, 12), 2, cfg.vocab_size, jnp.int32)
-    mask = jnp.ones((2, 12), jnp.int32)
+    # PADDED encoder: pad-position activations must not inflate the
+    # quantization scales (they are zeroed before amax)
+    mask = jnp.ones((2, 12), jnp.int32).at[:, 9:].set(0)
     enc = model.apply({"params": params}, ids, mask, method=model.encode)
 
     cache_a = init_cache(model, params, 2, 8, enc, mask)

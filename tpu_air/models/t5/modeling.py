@@ -140,11 +140,21 @@ class Attention(nn.Module):
 
         def _quant(x):
             # per-(batch, head, channel) scale over the length dim: the
-            # length axis is what streams from HBM every step
-            amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1, keepdims=True)
+            # length axis is what streams from HBM every step.  Pad
+            # positions are zeroed FIRST — they are masked out of the
+            # scores anyway, and a pad-position activation outlier would
+            # otherwise inflate the scale and coarsen the grid for every
+            # valid token in its channel.
+            xf = x.astype(jnp.float32)
+            if kv_mask is not None:
+                xf = xf * kv_mask[:, :, None, None].astype(jnp.float32)
+            amax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
             scale = jnp.maximum(amax, 1e-8) / 127.0
-            q8 = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+            q8 = jnp.clip(jnp.round(xf / scale), -127, 127)
             return q8.astype(jnp.int8), scale
+
+        def _dequant(q8, scale):
+            return (q8.astype(jnp.float32) * scale).astype(dtype)
 
         if cross_decode and self.has_variable("cache", "cached_key"):
             # Cross-attention during cached decode: K/V are an invariant of
@@ -155,10 +165,8 @@ class Attention(nn.Module):
             k = self.get_variable("cache", "cached_key")
             v = self.get_variable("cache", "cached_value")
             if cache_int8:
-                k = (k.astype(jnp.float32)
-                     * self.get_variable("cache", "cached_key_scale")).astype(dtype)
-                v = (v.astype(jnp.float32)
-                     * self.get_variable("cache", "cached_value_scale")).astype(dtype)
+                k = _dequant(k, self.get_variable("cache", "cached_key_scale"))
+                v = _dequant(v, self.get_variable("cache", "cached_value_scale"))
         else:
             k = dense("k")(kv_hidden)    # [b, k, h, d]
             v = dense("v")(kv_hidden)
@@ -172,8 +180,8 @@ class Attention(nn.Module):
                     self.variable("cache", "cached_value_scale", lambda: vs)
                     # the init pass itself attends with the dequantized
                     # values so its output matches later steps
-                    k = (kq.astype(jnp.float32) * ks).astype(dtype)
-                    v = (vq.astype(jnp.float32) * vs).astype(dtype)
+                    k = _dequant(kq, ks)
+                    v = _dequant(vq, vs)
                 else:
                     self.variable("cache", "cached_key", lambda: k)
                     self.variable("cache", "cached_value", lambda: v)
