@@ -281,3 +281,30 @@ def test_int8_cross_kv_cache_numerics(tiny):
     assert np.abs(a - b).max() / denom < 0.05, np.abs(a - b).max() / denom
     # greedy next tokens agree on this tiny case
     np.testing.assert_array_equal(a.argmax(-1), b.argmax(-1))
+
+
+def test_generate_batch_bucketing_reuses_compilation(tiny):
+    """Ragged batch sizes pad to a power-of-two bucket: outputs match the
+    unpadded rows exactly (greedy) and a second ragged size in the same
+    bucket reuses the compiled program (SURVEY.md §7 hard-part 2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_air.models.t5 import generate as gen_mod
+    from tpu_air.models.t5.generate import _GEN_CACHE, generate
+
+    cfg, model, params = tiny
+    rng = np.random.default_rng(5)
+    ids8 = rng.integers(2, cfg.vocab_size, size=(8, 10)).astype(np.int32)
+    mask8 = np.ones((8, 10), np.int32)
+
+    _GEN_CACHE.clear()
+    y8 = np.asarray(generate(model, params, ids8, mask8, max_new_tokens=6))
+    y5 = np.asarray(generate(model, params, ids8[:5], mask8[:5], max_new_tokens=6))
+    y7 = np.asarray(generate(model, params, ids8[:7], mask8[:7], max_new_tokens=6))
+    # bucket padding must not change any real row (greedy, per-row attention)
+    np.testing.assert_array_equal(y5, y8[:5])
+    np.testing.assert_array_equal(y7, y8[:7])
+    # 5, 7 and 8 all land in the SAME compiled program (bucket 8)
+    (fn,) = _GEN_CACHE.values()
+    assert fn._cache_size() == 1, fn._cache_size()

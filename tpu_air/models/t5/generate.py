@@ -107,7 +107,9 @@ def make_generate_fn(
         cache = init_cache(model, params, batch, max_new_tokens + 1, enc,
                            attention_mask)
         tok0 = jnp.full((batch,), start_id, dtype=jnp.int32)
-        finished0 = jnp.zeros((batch,), dtype=jnp.bool_)
+        # an all-pad input row is vacuous (bucket padding, empty inputs):
+        # born finished, it emits pure pad and never blocks early-stop
+        finished0 = jnp.sum(attention_mask, axis=-1) == 0
 
         def decode_one(tok, cache, finished, rng):
             logits, vars_out = model.apply(
@@ -194,5 +196,20 @@ def generate(
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    # batch-size BUCKETING (SURVEY.md §7 hard-part 2): pad the batch up to
+    # the next power of two with all-pad rows (born finished, emit pad, cost
+    # ~0 under early_stop) so a stream of blocks with a ragged tail reuses
+    # one compiled program instead of retracing per batch size.
+    n = input_ids.shape[0]
+    bucket = 1 << max(0, int(n - 1).bit_length())
+    if bucket != n:
+        pad_id = model.config.pad_token_id
+        L = input_ids.shape[1]
+        input_ids = jnp.concatenate(
+            [input_ids, jnp.full((bucket - n, L), pad_id, jnp.int32)]
+        )
+        attention_mask = jnp.concatenate(
+            [attention_mask, jnp.zeros((bucket - n, L), jnp.int32)]
+        )
     seqs, _steps = _GEN_CACHE[key](params, input_ids, attention_mask, rng)
-    return seqs
+    return seqs[:n]
