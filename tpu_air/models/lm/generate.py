@@ -128,4 +128,14 @@ def generate(model: CausalLM, params, input_ids, max_new_tokens: int = 64,
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    return _GEN_CACHE[key](params, jnp.asarray(input_ids, jnp.int32), rng)
+    ids = jnp.asarray(input_ids, jnp.int32)
+    # batch-size bucketing (t5/generate.py pattern): a ragged tail batch
+    # reuses the compiled program; the filler rows' outputs are discarded
+    n = ids.shape[0]
+    bucket = 1 << max(0, int(n - 1).bit_length())
+    if bucket != n:
+        ids = jnp.concatenate(
+            [ids, jnp.full((bucket - n, ids.shape[1]),
+                           model.config.pad_token_id, jnp.int32)]
+        )
+    return _GEN_CACHE[key](params, ids, rng)[:n]
