@@ -645,6 +645,20 @@ def _child_main() -> None:
 
     from tpu_air.models.t5 import T5Config, T5ForConditionalGeneration
 
+    child_t0 = time.time()
+    # Optional sections (kernels/generation/segformer/mfu) are skipped —
+    # with a visible note — once the child has spent this long, so a slow
+    # run degrades to a smaller artifact instead of losing EVERYTHING to
+    # the parent's subprocess timeout mid-section.
+    child_budget = float(os.environ.get("TPU_AIR_BENCH_CHILD_BUDGET", "1800"))
+    skipped_sections = []
+
+    def budget_left(section: str) -> bool:
+        if time.time() - child_t0 < child_budget:
+            return True
+        skipped_sections.append(section)
+        return False
+
     dev = jax.devices()[0]
     platform = dev.platform
     on_tpu = platform == "tpu"
@@ -695,36 +709,41 @@ def _child_main() -> None:
     mfu_breakdown = None
     if on_tpu:
         try:
-            long_context = _measure_long_context_attention()
+            if budget_left("long_context"):
+                long_context = _measure_long_context_attention()
         except Exception as e:  # noqa: BLE001 — visible, never fatal
             long_context_error = f"{type(e).__name__}: {e}"
             print(f"long-context attention bench failed: {long_context_error}",
                   file=sys.stderr)
         try:
-            generation = _measure_generation(model, config, params)
+            if budget_left("generation"):
+                generation = _measure_generation(model, config, params)
         except Exception as e:  # noqa: BLE001 — visible, never fatal
             generation_error = f"{type(e).__name__}: {e}"
             print(f"generation bench failed: {generation_error}", file=sys.stderr)
         try:
             # opt-in int8 cross-KV cache: halves the dominant decode HBM
             # term — measured side-by-side so the artifact shows the delta
-            cfg8 = T5Config.from_dict({**config.to_dict(),
-                                       "decode_cache_int8": True})
-            generation_int8 = _measure_generation(
-                T5ForConditionalGeneration(cfg8), cfg8, params
-            )
+            if budget_left("generation_int8"):
+                cfg8 = T5Config.from_dict({**config.to_dict(),
+                                           "decode_cache_int8": True})
+                generation_int8 = _measure_generation(
+                    T5ForConditionalGeneration(cfg8), cfg8, params
+                )
         except Exception as e:  # noqa: BLE001 — visible in the artifact
             generation_int8_error = f"{type(e).__name__}: {e}"
             print(f"int8 generation bench failed: {e}", file=sys.stderr)
         try:
-            segformer = _measure_segformer(batch=32, img=512, on_tpu=True)
+            if budget_left("segformer"):
+                segformer = _measure_segformer(batch=32, img=512, on_tpu=True)
         except Exception as e:  # noqa: BLE001 — visible, never fatal
             segformer_error = f"{type(e).__name__}: {e}"
             print(f"segformer bench failed: {segformer_error}", file=sys.stderr)
         try:
-            mfu_breakdown = _measure_mfu_breakdown(
-                model, config, params, batch, enc_len, dec_len
-            )
+            if budget_left("mfu_breakdown"):
+                mfu_breakdown = _measure_mfu_breakdown(
+                    model, config, params, batch, enc_len, dec_len
+                )
         except Exception as e:  # noqa: BLE001 — visible, never fatal
             mfu_breakdown = {"error": f"{type(e).__name__}: {e}"}
             print(f"mfu breakdown failed: {e}", file=sys.stderr)
@@ -847,6 +866,8 @@ def _child_main() -> None:
         result["segformer_error"] = segformer_error
     if mfu_breakdown is not None:
         result["mfu_breakdown"] = mfu_breakdown
+    if skipped_sections:
+        result["sections_skipped_for_budget"] = skipped_sections
     print(json.dumps(result), flush=True)
 
 
