@@ -115,3 +115,11 @@ def test_inference_architectures_example():
     proc = _run_example("inference_architectures.py", "--images", "12")
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "vs sequential" in proc.stdout and "BatchPredictor" in proc.stdout
+
+
+@pytest.mark.slow
+def test_multihost_training_example():
+    proc = _run_example("multihost_training.py", timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "MULTIHOST-EXAMPLE-OK" in proc.stdout
+    assert "hosts=2" in proc.stdout
