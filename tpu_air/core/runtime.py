@@ -1032,18 +1032,36 @@ class Runtime:
             st.pending += 1
             self.task_resources[spec.task_id] = {}
             self.task_worker[spec.task_id] = st.worker.worker_id
-            st.worker.conn.send(
-                (
-                    "actor_task",
-                    {
-                        "task_id": spec.task_id,
-                        "payload": spec.payload,
-                        "payload_ref": spec.payload_ref,
-                        "actor_id": spec.actor_id,
-                        "method": spec.method,
-                    },
+            try:
+                st.worker.conn.send(
+                    (
+                        "actor_task",
+                        {
+                            "task_id": spec.task_id,
+                            "payload": spec.payload,
+                            "payload_ref": spec.payload_ref,
+                            "actor_id": spec.actor_id,
+                            "method": spec.method,
+                        },
+                    )
                 )
-            )
+            except OSError:
+                # the worker died between the liveness check and the send
+                # (broken pipe before the listener reaps it) — resolve the
+                # call as actor death instead of leaking an OSError into
+                # the caller (serve failover keys off ActorDiedError); the
+                # listener's death path does the full cleanup when it lands
+                st.pending -= 1
+                self.task_resources.pop(spec.task_id, None)
+                self.task_worker.pop(spec.task_id, None)
+                self.store.put(
+                    _ErrorSentinel(
+                        f"ActorDiedError(actor={spec.actor_id})",
+                        "worker pipe broken at submit",
+                    ),
+                    spec.task_id,
+                )
+                self._notify_objects()
 
     def actor_pending_placement(self, actor_id: str) -> bool:
         """True while the actor's creation is still queued for resources
