@@ -47,7 +47,8 @@ def snapshot() -> Dict[str, Any]:
         out = {
             "initialized": True,
             "session_id": rt.session_id,
-            "resources": {"cpu": rt.num_cpus, "chip": rt.num_chips},
+            "resources": {"cpu": rt.num_cpus, "chip": rt.num_chips,
+                          "chips_per_host": getattr(rt, "chips_per_host", rt.num_chips or 1)},
             "available": dict(rt.avail),
             "free_chips": list(rt.free_chips),
             "queue_depth": len(rt.queue),
