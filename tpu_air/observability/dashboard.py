@@ -48,7 +48,7 @@ def snapshot() -> Dict[str, Any]:
             "initialized": True,
             "session_id": rt.session_id,
             "resources": {"cpu": rt.num_cpus, "chip": rt.num_chips,
-                          "chips_per_host": getattr(rt, "chips_per_host", rt.num_chips or 1)},
+                          "chips_per_host": rt.chips_per_host},
             "available": dict(rt.avail),
             "free_chips": list(rt.free_chips),
             "queue_depth": len(rt.queue),
