@@ -939,6 +939,25 @@ def _probe_backend(env: dict, timeout: float):
 
 
 def main() -> None:
+    # Exactly ONE bench may touch the chip at a time: two processes on the
+    # tunnel wedge each other (round-3 lesson).  A second invocation blocks
+    # on the lock (up to ~75 min) and then runs — typically fast, because
+    # the first one persisted the round's TPU headline.
+    import fcntl
+
+    lock_path = os.environ.get("TPU_AIR_BENCH_LOCK", "/tmp/tpu_air-bench.lock")
+    lock_f = open(lock_path, "w")
+    deadline_lock = time.time() + 4500
+    while True:
+        try:
+            fcntl.flock(lock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            break
+        except OSError:
+            if time.time() > deadline_lock:
+                print("another bench holds the lock past the wait budget",
+                      file=sys.stderr)
+                break
+            time.sleep(10)
     probe_timeout = float(os.environ.get("TPU_AIR_BENCH_PROBE_TIMEOUT", "300"))
     probe_attempts = int(os.environ.get("TPU_AIR_BENCH_PROBE_ATTEMPTS", "4"))
     probe_backoff = float(os.environ.get("TPU_AIR_BENCH_PROBE_BACKOFF", "45"))
