@@ -399,3 +399,31 @@ def test_tuner_over_lm_trainer_sequence_parallel(air):
     best = grid.get_best_result()
     assert best.checkpoint is not None
     assert best.metrics["mesh_sequence"] == 2
+
+
+def test_tuner_survives_hard_trial_crash(air):
+    """A trial whose WORKER PROCESS dies outright (os._exit, the
+    SIGKILL-class failure — not a Python exception) is isolated: the sweep
+    completes, the crash lands in ResultGrid.errors, and the dead trial's
+    chip lease returns to the pool."""
+    import tpu_air as _ta
+
+    def loop(config):
+        import os as _os
+
+        if config["x"] == 2:
+            _os._exit(37)  # hard death mid-trial
+        session.report({"score": float(config["x"])})
+
+    grid = tune.Tuner(
+        loop,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=1),
+    ).fit()
+    assert len(grid) == 3
+    assert grid.num_errors == 1
+    assert grid.get_best_result().metrics["score"] == 3.0
+    # the dead trial's lease must be back: full chip availability restored
+    rt = _ta.core.runtime.get_runtime()
+    assert rt.avail["chip"] == float(rt.num_chips), rt.avail
+    assert sorted(rt.free_chips) == list(range(rt.num_chips))
