@@ -419,3 +419,30 @@ def test_hist_gbdt_learns_and_is_deterministic():
     # regression boosters must not expose predict_proba (GBDTPredictor
     # branches on hasattr)
     assert not hasattr(mr, "predict_proba")
+
+
+def test_hist_gbdt_accuracy_comparable_to_sklearn():
+    """Quality guard for the from-scratch histogram booster: held-out error
+    within a small margin of sklearn's GradientBoostingClassifier at the
+    same depth/rounds/learning rate on a nonlinear problem."""
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from tpu_air.train.hist_gbdt import HistGBDT
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(1200, 6))
+    y = ((X[:, 0] * X[:, 1] + 0.8 * np.sin(2 * X[:, 2]) + 0.3 * X[:, 3]) > 0
+         ).astype(float)
+    Xtr, ytr, Xva, yva = X[:900], y[:900], X[900:], y[900:]
+
+    ours = HistGBDT(eta=0.2, max_depth=4, max_bins=128)
+    ours.setup(Xtr, ytr)
+    for _ in range(30):
+        ours.fit_one_round()
+    err_ours = float(np.mean(ours.predict(Xva) != yva))
+
+    sk = GradientBoostingClassifier(
+        n_estimators=30, learning_rate=0.2, max_depth=4, random_state=0
+    ).fit(Xtr, ytr)
+    err_sk = float(np.mean(sk.predict(Xva) != yva))
+    assert err_ours <= err_sk + 0.05, (err_ours, err_sk)
