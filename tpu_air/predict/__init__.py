@@ -11,6 +11,7 @@ from tpu_air.predict.predictors import (
     JaxPredictor,
     SemanticSegmentationPredictor,
     SklearnPredictor,
+    XGBoostPredictor,
     LMGenerativePredictor,
     T5GenerativePredictor,
 )
@@ -22,6 +23,7 @@ __all__ = [
     "JaxPredictor",
     "SemanticSegmentationPredictor",
     "SklearnPredictor",
+    "XGBoostPredictor",
     "LMGenerativePredictor",
     "T5GenerativePredictor",
 ]

@@ -325,3 +325,7 @@ class GBDTPredictor(Predictor):
 
 class SklearnPredictor(GBDTPredictor):
     """Alias family for generic sklearn estimators stored in checkpoints."""
+
+
+#: Drop-in alias matching the reference import name (Introduction…ipynb:cc-57)
+XGBoostPredictor = GBDTPredictor
