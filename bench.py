@@ -549,7 +549,12 @@ def _decode_step_bytes(config, batch, enc_len, max_decode_len) -> dict:
     int8_cache = getattr(config, "decode_cache_int8", False)
     cross_el = 1 if int8_cache else bytes_el
     cross_kv = 2 * batch * enc_len * h_d * cross_el * layers
-    self_kv = 2 * batch * max_decode_len * h_d * bytes_el * layers
+    if int8_cache:
+        # int8 slabs + per-(batch, position, head) f32 scales
+        self_kv = (2 * batch * max_decode_len * h_d
+                   + 2 * batch * max_decode_len * config.num_heads * 4) * layers
+    else:
+        self_kv = 2 * batch * max_decode_len * h_d * bytes_el * layers
     # decoder params per layer: self q/k/v/o + cross q/o (cross k/v cached)
     # + FFN (gated: wi_0, wi_1, wo)
     d, ff = config.d_model, config.d_ff

@@ -271,11 +271,23 @@ def test_int8_cross_kv_cache_numerics(tiny):
     assert ck.dtype == jnp.int8, ck.dtype
     assert "cached_key_scale" in cache_b["decoder"]["layer_0"]["cross_attn"]
 
+    # self-attn slabs are int8 too (per-position scales)
+    sk = cache_b["decoder"]["layer_0"]["self_attn"]["cached_key"]
+    assert sk.dtype == jnp.int8, sk.dtype
+    assert "cached_key_scale" in cache_b["decoder"]["layer_0"]["self_attn"]
+
+    # run THREE decode steps so the quantized self-cache is actually read
     tok = jnp.full((2, 1), cfg.decoder_start_token_id, jnp.int32)
-    la, _ = model.apply({"params": params, "cache": cache_a}, tok, enc, mask,
-                        decode=True, mutable=["cache"], method=model.decode)
-    lb, _ = m8.apply({"params": params, "cache": cache_b}, tok, enc, mask,
-                     decode=True, mutable=["cache"], method=m8.decode)
+    la = lb = None
+    for _ in range(3):
+        la, vars_a = model.apply(
+            {"params": params, "cache": cache_a}, tok, enc, mask,
+            decode=True, mutable=["cache"], method=model.decode)
+        lb, vars_b = m8.apply(
+            {"params": params, "cache": cache_b}, tok, enc, mask,
+            decode=True, mutable=["cache"], method=m8.decode)
+        cache_a, cache_b = vars_a["cache"], vars_b["cache"]
+        tok = jnp.argmax(np.asarray(la)[:, -1:], axis=-1).astype(jnp.int32)
     a, b = np.asarray(la), np.asarray(lb)
     denom = np.maximum(np.abs(a).max(), 1e-6)
     assert np.abs(a - b).max() / denom < 0.05, np.abs(a - b).max() / denom

@@ -189,18 +189,47 @@ class Attention(nn.Module):
         if decode:
             # Cache layout [b, max_len, h, d]; cache vars are created ahead of
             # time by init_cache (eval_shape) so is_init only occurs there.
+            # With decode_cache_int8 the slabs are int8 with a per-(batch,
+            # position, head) scale over the channel dim, quantized
+            # incrementally as each step's K/V land — the self-attention
+            # half of the decode-bandwidth story (cross is quantized whole
+            # at cache init above).
             is_init = not self.has_variable("cache", "cached_key")
-            ck = self.variable("cache", "cached_key", jnp.zeros, k.shape, dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros, v.shape, dtype)
+            slab_dtype = jnp.int8 if cache_int8 else dtype
+            ck = self.variable("cache", "cached_key", jnp.zeros, k.shape, slab_dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros, v.shape, slab_dtype)
+            if cache_int8:
+                cks = self.variable("cache", "cached_key_scale", jnp.zeros,
+                                    k.shape[:-1] + (1,), jnp.float32)
+                cvs = self.variable("cache", "cached_value_scale", jnp.zeros,
+                                    v.shape[:-1] + (1,), jnp.float32)
             idx = self.variable(
                 "cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32)
             )
             if not is_init:
                 cur = idx.value
-                ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
-                idx.value = cur + q.shape[1]
-                k, v = ck.value, cv.value
+                if cache_int8:
+                    def _quant_pos(x):
+                        xf = x.astype(jnp.float32)
+                        amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+                        s = jnp.maximum(amax, 1e-8) / 127.0
+                        x8 = jnp.clip(jnp.round(xf / s), -127, 127)
+                        return x8.astype(jnp.int8), s
+
+                    k8, ks_ = _quant_pos(k)
+                    v8, vs_ = _quant_pos(v)
+                    ck.value = jax.lax.dynamic_update_slice(ck.value, k8, (0, cur, 0, 0))
+                    cks.value = jax.lax.dynamic_update_slice(cks.value, ks_, (0, cur, 0, 0))
+                    cv.value = jax.lax.dynamic_update_slice(cv.value, v8, (0, cur, 0, 0))
+                    cvs.value = jax.lax.dynamic_update_slice(cvs.value, vs_, (0, cur, 0, 0))
+                    idx.value = cur + q.shape[1]
+                    k = _dequant(ck.value, cks.value)
+                    v = _dequant(cv.value, cvs.value)
+                else:
+                    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+                    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+                    idx.value = cur + q.shape[1]
+                    k, v = ck.value, cv.value
 
         qlen, klen = q.shape[1], k.shape[1]
         # Pallas blockwise path: eligible when callers passed the structured
