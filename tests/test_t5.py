@@ -320,3 +320,30 @@ def test_generate_batch_bucketing_reuses_compilation(tiny):
     # 5, 7 and 8 all land in the SAME compiled program (bucket 8)
     (fn,) = _GEN_CACHE.values()
     assert fn._cache_size() == 1, fn._cache_size()
+
+
+def test_generate_feature_composition_int8_earlystop_bucketing(tiny):
+    """The three round-4 generation features COMPOSE: an int8-cache model
+    with early-EOS stopping (default) and a ragged batch (bucket padding)
+    produces the same greedy tokens as the SAME int8 model on the full
+    batch — bucketing/early-stop must not perturb outputs.  (bf16-vs-int8
+    token equality is not asserted: near-tie logits may legitimately flip
+    under quantization on random tiny weights.)"""
+    import dataclasses
+
+    from tpu_air.models.t5 import T5ForConditionalGeneration
+    from tpu_air.models.t5.generate import _GEN_CACHE
+
+    cfg, model, params = tiny
+    m8 = T5ForConditionalGeneration(
+        dataclasses.replace(cfg, decode_cache_int8=True)
+    )
+    rng = np.random.default_rng(9)
+    ids = rng.integers(2, cfg.vocab_size, size=(8, 12)).astype(np.int32)
+    mask = np.ones((8, 12), np.int32)
+
+    _GEN_CACHE.clear()
+    base = np.asarray(generate(m8, params, ids, mask, max_new_tokens=6))
+    got = np.asarray(generate(m8, params, ids[:5], mask[:5], max_new_tokens=6))
+    np.testing.assert_array_equal(got, base[:5])
+    assert base.shape == (8, 6)
