@@ -199,7 +199,11 @@ def generate(
     # batch-size BUCKETING (SURVEY.md §7 hard-part 2): pad the batch up to
     # the next power of two with all-pad rows (born finished, emit pad, cost
     # ~0 under early_stop) so a stream of blocks with a ragged tail reuses
-    # one compiled program instead of retracing per batch size.
+    # one compiled program instead of retracing per batch size.  GREEDY
+    # outputs are bit-identical to the unpadded batch; SAMPLED outputs are
+    # distributionally equivalent but not bitwise reproducible across
+    # bucket sizes (the per-position sampling noise is keyed by the padded
+    # batch shape).
     n = input_ids.shape[0]
     bucket = 1 << max(0, int(n - 1).bit_length())
     if bucket != n:
