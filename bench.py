@@ -569,11 +569,20 @@ def _decode_step_bytes(config, batch, enc_len, max_decode_len) -> dict:
         "total_bytes": cross_kv + self_kv + params_b,
     }
     if int8_cache:
-        # honest caveat: the halved cross bytes assume XLA fuses the dequant
-        # multiply into the attention einsum operand load; if it materializes
-        # the dequantized bf16 K/V instead, real traffic is HIGHER than this
-        # model and the roofline fraction overstates efficiency
+        # honest caveat: the reduced cross AND self slab bytes both assume
+        # XLA fuses the dequant multiply into the attention einsum operand
+        # load; if it materializes the dequantized bf16/f32 K/V instead,
+        # real traffic is HIGHER than this model and the roofline fraction
+        # overstates efficiency.  A materialization-pessimistic upper bound
+        # (every int8 slab re-expanded to full-width each step) is reported
+        # alongside the fused lower bound.
         out["assumes_fused_dequant"] = True
+        cross_kv_wide = 2 * batch * enc_len * h_d * bytes_el * layers
+        self_kv_wide = 2 * batch * max_decode_len * h_d * bytes_el * layers
+        out["total_bytes_if_dequant_materialized"] = (
+            cross_kv + self_kv + params_b
+            + cross_kv_wide + self_kv_wide
+        )
     return out
 
 
@@ -959,9 +968,23 @@ def main() -> None:
             break
         except OSError:
             if time.time() > deadline_lock:
-                print("another bench holds the lock past the wait budget",
-                      file=sys.stderr)
-                break
+                # Running WITHOUT the lock is strictly worse than not running:
+                # two processes on the tunnel wedge each other (the exact
+                # failure the lock exists to prevent).  Fail fast — but still
+                # exit 0 with a JSON line so the driver records the attempt.
+                print("another bench holds the lock past the wait budget; "
+                      "refusing to run unlocked", file=sys.stderr)
+                print(json.dumps({
+                    "metric": "finetune_tokens_per_sec_per_chip",
+                    "value": None,
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": None,
+                    "platform": None,
+                    "measurement_valid": False,
+                    "error": "bench lock held past 4500s wait budget; "
+                             "refused to run concurrently",
+                }))
+                return
             time.sleep(10)
     probe_timeout = float(os.environ.get("TPU_AIR_BENCH_PROBE_TIMEOUT", "300"))
     probe_attempts = int(os.environ.get("TPU_AIR_BENCH_PROBE_ATTEMPTS", "4"))

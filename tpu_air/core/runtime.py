@@ -886,26 +886,35 @@ class Runtime:
 
     def _claim_queued_actors(self):
         """FAST phase, runs synchronously inside ``_schedule`` (any thread):
-        claim resources for queued actor creations that now fit, in strict
-        FIFO — if the head of the queue doesn't fit, later (smaller) requests
-        do NOT jump it, and because the claim happens before ``_schedule``
-        dispatches tasks, a stream of chip tasks cannot outrace a queued
-        chip lease either.  The slow process spawn is handed to the
-        placement thread via ``_to_spawn``."""
+        claim resources for queued actor creations that now fit, FIFO with
+        one carve-out — if the head's chip COUNT doesn't fit, later (smaller)
+        requests do NOT jump it (strict FIFO, so a big lease can't be starved
+        by a stream of small ones), but a head whose count fits while no
+        valid lease SHAPE exists (e.g. 4 chips free as 2+2 across hosts
+        cannot serve a 4-chip single-host lease) is scanned PAST, so
+        fragmentation cannot stall unrelated work indefinitely.  Starvation
+        bound for the skipped head: it stays first in queue and is re-tried
+        on every release; the later requests allowed past it can only use
+        chips in shapes the head cannot — the moment a feasible shape frees
+        up, the head claims before anything behind it.  Because the claim
+        happens before ``_schedule`` dispatches tasks, a stream of chip
+        tasks cannot outrace a queued chip lease either.  The slow process
+        spawn is handed to the placement thread via ``_to_spawn``."""
         claimed = False
         with self.lock:
-            while self.actor_queue:
-                rec = self.actor_queue[0]
+            i = 0
+            while i < len(self.actor_queue):
+                rec = self.actor_queue[i]
                 if not self._can_fit(rec["resources"]):
                     break
                 nchips = int(rec["resources"].get("chip", 0))
-                # shape-aware claim: counts may fit while no valid lease
-                # SHAPE exists yet (e.g. 4 free chips spread over 2 hosts
-                # cannot serve a 4-chip single-host lease) — stay queued
                 chip_ids = self._claim_chips(nchips)
                 if chip_ids is None:
-                    break
-                self.actor_queue.pop(0)
+                    # shape-blocked (count fits, no feasible shape): skip
+                    # this one, keep scanning for satisfiable requests
+                    i += 1
+                    continue
+                self.actor_queue.pop(i)
                 self._acquire(rec["resources"])
                 self._to_spawn.append((rec, chip_ids))
                 claimed = True

@@ -46,11 +46,18 @@ def init_cache(model: CausalLM, batch_size: int):
 
 def make_lm_generate_fn(model: CausalLM, max_new_tokens: int,
                         do_sample: bool = False, temperature: float = 1.0,
-                        top_k: int = 0, eos_token_id: Optional[int] = None):
+                        top_k: int = 0, eos_token_id: Optional[int] = None,
+                        early_stop: bool = True):
     """Build a jitted ``fn(params, input_ids, rng) -> (B, max_new_tokens)``.
 
     ``input_ids``: (B, L_prompt) un-padded prompts (fixed shape per compile).
-    After ``eos_token_id`` is emitted a row keeps emitting pad."""
+    After ``eos_token_id`` is emitted a row keeps emitting pad.
+
+    ``early_stop=True`` (requires ``eos_token_id``; the t5/generate.py
+    pattern) runs the decode as a ``lax.while_loop`` that exits once EVERY
+    row has emitted EOS — outputs identical to the full-budget scan, the
+    remaining steps just don't execute.  With ``eos_token_id=None`` there
+    is no stopping criterion and the fixed-trip scan runs regardless."""
     cfg = model.config
     pad = cfg.pad_token_id
 
@@ -82,10 +89,16 @@ def make_lm_generate_fn(model: CausalLM, max_new_tokens: int,
         head_w = head_weight(params, cfg).astype(jnp.float32)
         rng, sub = jax.random.split(rng)
         tok = pick(hidden[:, -1].astype(jnp.float32) @ head_w, sub)
-        done = (tok == eos_token_id) if eos_token_id is not None else None
+        if eos_token_id is not None:
+            # an all-pad row is bucket filler: born finished, it emits pure
+            # pad and never holds the while_loop open for the full budget
+            filler = jnp.all(input_ids == pad, axis=-1)
+            tok = jnp.where(filler, pad, tok)
+            done = filler | (tok == eos_token_id)
+        else:
+            done = None
 
-        def step(carry, _):
-            cache, tok, pos, rng, done = carry
+        def decode_one(cache, tok, pos, rng, done):
             hidden, vars_ = dmodel.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 jnp.full((b, 1), pos, jnp.int32), decode=True,
@@ -96,7 +109,37 @@ def make_lm_generate_fn(model: CausalLM, max_new_tokens: int,
             if done is not None:
                 nxt = jnp.where(done, pad, nxt)
                 done = done | (nxt == eos_token_id)
-            return (vars_["cache"], nxt, pos + 1, rng, done), nxt
+            return vars_["cache"], nxt, pos + 1, rng, done
+
+        if early_stop and done is not None:
+            toks0 = jnp.full((b, max_new_tokens), pad, jnp.int32)
+            toks0 = toks0.at[:, 0].set(tok)
+
+            def cond(carry):
+                step, _, _, _, _, done, _ = carry
+                return (step < max_new_tokens) & ~jnp.all(done)
+
+            def body(carry):
+                step, cache, tok, pos, rng, done, toks = carry
+                cache, nxt, pos, rng, done = decode_one(
+                    cache, tok, pos, rng, done
+                )
+                toks = jax.lax.dynamic_update_slice(
+                    toks, nxt[:, None], (0, step)
+                )
+                return (step + 1, cache, nxt, pos, rng, done, toks)
+
+            (_, _, _, _, _, _, toks) = jax.lax.while_loop(
+                cond, body,
+                (jnp.asarray(1), vars_["cache"], tok, jnp.int32(lp), rng,
+                 done, toks0),
+            )
+            return toks
+
+        def step(carry, _):
+            cache, tok, pos, rng, done = carry
+            cache, nxt, pos, rng, done = decode_one(cache, tok, pos, rng, done)
+            return (cache, nxt, pos, rng, done), nxt
 
         # the prefill already produced token 0; the scan computes (and
         # emits) the remaining max_new_tokens - 1 — no discarded forward
@@ -115,22 +158,32 @@ _GEN_CACHE_MAX = 16
 
 def generate(model: CausalLM, params, input_ids, max_new_tokens: int = 64,
              do_sample: bool = False, temperature: float = 1.0, top_k: int = 0,
-             eos_token_id: Optional[int] = None, rng=None):
+             eos_token_id: Optional[int] = None, rng=None,
+             early_stop: bool = True):
     """Convenience wrapper caching compiled generate fns per config (the
     t5/generate.py pattern — repeated same-shape calls never retrace)."""
     cfg_key = tuple(sorted(model.config.to_dict().items()))
-    key = (cfg_key, max_new_tokens, do_sample, temperature, top_k, eos_token_id)
+    key = (cfg_key, max_new_tokens, do_sample, temperature, top_k,
+           eos_token_id, early_stop)
     if key not in _GEN_CACHE:
         if len(_GEN_CACHE) >= _GEN_CACHE_MAX:
             _GEN_CACHE.pop(next(iter(_GEN_CACHE)))
         _GEN_CACHE[key] = make_lm_generate_fn(
-            model, max_new_tokens, do_sample, temperature, top_k, eos_token_id
+            model, max_new_tokens, do_sample, temperature, top_k, eos_token_id,
+            early_stop,
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)
     ids = jnp.asarray(input_ids, jnp.int32)
     # batch-size bucketing (t5/generate.py pattern): a ragged tail batch
-    # reuses the compiled program; the filler rows' outputs are discarded
+    # reuses the compiled program; the filler rows' outputs are discarded.
+    # Same semantics caveat as the T5 path: GREEDY outputs are bit-identical
+    # to the unpadded batch; SAMPLED outputs are distributionally equivalent
+    # but not bitwise reproducible across bucket sizes (sampling noise is
+    # keyed by the padded batch shape).  With ``eos_token_id`` set, filler
+    # rows are born finished and cost ~0 under early_stop; with no EOS the
+    # fixed-trip scan runs filler rows for the full decode budget — the
+    # bucketing win is then compile-cache reuse only.
     n = ids.shape[0]
     bucket = 1 << max(0, int(n - 1).bit_length())
     if bucket != n:
