@@ -644,7 +644,9 @@ class Runtime:
     def _can_fit(self, res: Dict[str, float]) -> bool:
         return all(self.avail.get(k, 0.0) >= v for k, v in res.items())
 
-    def _claim_chips(self, n: int) -> Optional[List[int]]:
+    def _claim_chips(
+        self, n: int, exclude_hosts: frozenset = frozenset()
+    ) -> Optional[List[int]]:
         """Topology-aware chip-lease allocation (docs/MULTIHOST.md §2).
 
         Shapes: a lease of ``n <= chips_per_host`` chips lives entirely on
@@ -654,14 +656,18 @@ class Runtime:
         mesh's collectives then ride ICI), so it is always a contiguous
         sub-slice rather than an arbitrary k-subset.  Returns None when the
         request doesn't tile the free topology right now (caller keeps it
-        queued, FIFO).  Caller holds the lock.
+        queued, FIFO).  ``exclude_hosts``: hosts reserved for an earlier
+        shape-blocked request in the queue (see ``_claim_queued_actors``) —
+        their free chips are invisible to this claim.  Caller holds the
+        lock.
         """
         if n == 0:
             return []
         cph = self.chips_per_host
         by_host: Dict[int, List[int]] = {}
         for c in sorted(self.free_chips):
-            by_host.setdefault(c // cph, []).append(c)
+            if c // cph not in exclude_hosts:
+                by_host.setdefault(c // cph, []).append(c)
         if n <= cph:
             fitting = [h for h, f in by_host.items() if len(f) >= n]
             if not fitting:
@@ -698,17 +704,69 @@ class Runtime:
         for k, v in res.items():
             self.avail[k] = self.avail.get(k, 0.0) + v
 
+    def _reserve_closest(self, nchips: int, reserved: set) -> None:
+        """Reserve the hosts a shape-blocked request is closest to
+        recombining (the whole free hosts for a multi-host span; the
+        freest host for a single-host lease).  Shared by the real queue
+        scan and its ``_queued_reservations`` simulation.  Caller holds
+        the lock; mutates ``reserved`` in place."""
+        cph = self.chips_per_host
+        free_by_host: Dict[int, int] = {}
+        for c in self.free_chips:
+            h = c // cph
+            if h not in reserved:
+                free_by_host[h] = free_by_host.get(h, 0) + 1
+        if nchips > cph:
+            whole = sorted(h for h, f in free_by_host.items() if f == cph)
+            reserved.update(whole[: nchips // cph])
+        elif free_by_host:
+            reserved.add(max(free_by_host, key=lambda h: (free_by_host[h], -h)))
+
+    def _queued_reservations(self) -> set:
+        """Hosts queued actor requests are entitled to, per the same FIFO
+        scan ``_claim_queued_actors`` runs — simulated claim-free (feasible
+        requests consume chips from a scratch copy of the free list;
+        shape-blocked ones reserve recombination hosts; the scan stops at
+        the first count-infeasible head, like the real one).  Driver-level
+        ``lease_chips`` consults this so it can neither nibble capacity a
+        shape-blocked queued request is waiting to recombine NOR outrace a
+        feasible queue head (a simulated claim reserves its hosts whole —
+        slightly broader than the claim itself, which only costs the
+        driver one extra 50 ms poll).  Caller holds the lock."""
+        saved = list(self.free_chips)
+        avail = dict(self.avail)
+        reserved: set = set()
+        try:
+            for rec in self.actor_queue:
+                if not all(avail.get(kk, 0.0) >= vv
+                           for kk, vv in rec["resources"].items()):
+                    break
+                nchips = int(rec["resources"].get("chip", 0))
+                ids = self._claim_chips(nchips, frozenset(reserved))
+                if ids is None:
+                    self._reserve_closest(nchips, reserved)
+                else:
+                    for kk, vv in rec["resources"].items():
+                        avail[kk] = avail.get(kk, 0.0) - vv
+                    reserved.update(c // self.chips_per_host for c in ids)
+        finally:
+            self.free_chips = saved
+        return reserved
+
     def lease_chips(self, n: int, timeout: Optional[float] = None) -> List[int]:
         """Driver-level chip lease (shape-aware, docs/MULTIHOST.md §2) for
         runs that execute on the driver itself rather than in an actor —
         the SPMD-multihost trainer path.  Blocks until a correctly-shaped
-        lease frees up.  Pair with :meth:`release_chips`."""
+        lease frees up, honoring the hosts reserved for queued actor
+        requests (``_queued_reservations``) so driver leases cannot starve
+        a shape-blocked queue head.  Pair with :meth:`release_chips`."""
         self._check_satisfiable({"chip": float(n)})
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             with self.lock:
                 if self._can_fit({"chip": float(n)}):
-                    ids = self._claim_chips(n)
+                    ids = self._claim_chips(
+                        n, frozenset(self._queued_reservations()))
                     if ids is not None:
                         self._acquire({"chip": float(n)})
                         return ids
@@ -812,12 +870,29 @@ class Runtime:
                     )
                 )
             self.queue = remaining
-            # Deadlock avoidance: a worker blocked on a nested task's result
-            # occupies its process slot, so nested submissions get fresh
-            # workers when the pool is saturated.
-            stuck = [s for s in remaining if s.from_worker and self._can_fit(s.resources)]
+            stuck = [s for s in remaining if self._can_fit(s.resources)]
             if stuck and not idle:
-                spawn_needed = min(len(stuck), 4)
+                # Grow the pool toward num_cpus for ANY dispatchable queued
+                # task: the initial pool is only min(2, num_cpus), and
+                # without growth driver-submitted parallelism stays capped
+                # at 2 workers regardless of num_cpus (the W9 20-parallel-
+                # tasks contract needs the full width).  Workers persist
+                # once spawned, so this converges after the first burst.
+                pool = sum(
+                    1 for w in self.workers.values()
+                    if w.alive and w.actor_id is None
+                )
+                headroom = max(0, int(self.num_cpus) - pool)
+                # Deadlock avoidance: a worker blocked on a nested task's
+                # result occupies its process slot, so nested submissions
+                # get fresh workers (beyond num_cpus if needed) when the
+                # pool is saturated.
+                nested = sum(1 for s in stuck if s.from_worker)
+                # cap each spawn burst: _placement_loop re-runs _schedule
+                # after the burst, so already-spawned workers start taking
+                # tasks between bursts instead of idling behind a serial
+                # spawn of num_cpus processes
+                spawn_needed = min(len(stuck), max(headroom, nested), 4)
         if spawn_needed:
             with self.lock:
                 self._spawn_requests = max(self._spawn_requests, spawn_needed)
@@ -892,26 +967,35 @@ class Runtime:
         by a stream of small ones), but a head whose count fits while no
         valid lease SHAPE exists (e.g. 4 chips free as 2+2 across hosts
         cannot serve a 4-chip single-host lease) is scanned PAST, so
-        fragmentation cannot stall unrelated work indefinitely.  Starvation
-        bound for the skipped head: it stays first in queue and is re-tried
-        on every release; the later requests allowed past it can only use
-        chips in shapes the head cannot — the moment a feasible shape frees
-        up, the head claims before anything behind it.  Because the claim
-        happens before ``_schedule`` dispatches tasks, a stream of chip
-        tasks cannot outrace a queued chip lease either.  The slow process
-        spawn is handed to the placement thread via ``_to_spawn``."""
+        fragmentation cannot stall unrelated work indefinitely.
+
+        Starvation bound for the skipped request: it RESERVES the hosts
+        closest to satisfying its shape (the currently-whole free hosts for
+        a multi-host span; the freest host for a single-host lease), and
+        requests behind it in the queue cannot claim chips on reserved
+        hosts — so a stream of small leases can consume fragments, never
+        the capacity the blocked request is waiting to recombine.
+        Reservations are recomputed on every pass in FIFO order, so the
+        moment a feasible shape exists the blocked request (scanned first,
+        with nothing reserved against it) claims before anything behind it.
+        Because the claim happens before ``_schedule`` dispatches tasks, a
+        stream of chip tasks cannot outrace a queued chip lease either.
+        The slow process spawn is handed to the placement thread via
+        ``_to_spawn``."""
         claimed = False
         with self.lock:
+            reserved: set = set()
             i = 0
             while i < len(self.actor_queue):
                 rec = self.actor_queue[i]
                 if not self._can_fit(rec["resources"]):
                     break
                 nchips = int(rec["resources"].get("chip", 0))
-                chip_ids = self._claim_chips(nchips)
+                chip_ids = self._claim_chips(nchips, frozenset(reserved))
                 if chip_ids is None:
-                    # shape-blocked (count fits, no feasible shape): skip
-                    # this one, keep scanning for satisfiable requests
+                    # shape-blocked: reserve the hosts this request is
+                    # closest to recombining, then keep scanning
+                    self._reserve_closest(nchips, reserved)
                     i += 1
                     continue
                 self.actor_queue.pop(i)
