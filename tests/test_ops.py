@@ -342,3 +342,111 @@ def test_attention_auto_dispatch_by_seq_len(monkeypatch):
     assert not calls, f"LM flash traced below the crossover: {calls}"
     lm.apply({"params": lp}, ids64)
     assert calls, "LM flash not traced at/above the crossover"
+
+
+# -- fused decode attention (ops/decode_attention.py) ------------------------
+
+
+def _dk_inputs(b=3, L=96, h=4, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, L, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, L, h, d)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((h, L)), jnp.float32)
+    mask = jnp.asarray(rng.integers(0, 2, (b, L)) | (np.arange(L) < 2),
+                       jnp.float32)
+    return q, k, v, bias, mask
+
+
+@pytest.mark.parametrize("with_bias", [False, True])
+@pytest.mark.parametrize("with_mask", [False, True])
+@pytest.mark.parametrize("block_k", [None, 32])
+def test_decode_attention_matches_reference(with_bias, with_mask, block_k):
+    """Single-token decode kernel == dense reference, chunked and single-
+    block, with the T5 decode operand shapes (additive [h, L] bias that
+    carries the causal mask; per-batch key-padding mask)."""
+    from tpu_air.ops.decode_attention import (
+        decode_attention, decode_attention_reference,
+    )
+
+    q, k, v, bias, mask = _dk_inputs()
+    kw = {}
+    if with_bias:
+        kw["bias"] = bias
+    if with_mask:
+        kw["kv_mask"] = mask
+    got = decode_attention(q, k, v, block_k=block_k, **kw)
+    want = decode_attention_reference(q, k, v, **kw)
+    assert got.shape == q.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("kind", ["pos", "chan"])
+def test_decode_attention_int8_scale_folding(kind):
+    """int8 slabs never materialize a dequantized copy: scales fold into
+    the kernel math (per-position -> scores/probs; per-channel -> q/out)
+    and must match the explicit-dequant reference exactly."""
+    from tpu_air.ops.decode_attention import (
+        decode_attention, decode_attention_reference,
+    )
+
+    b, L, h, d = 3, 96, 4, 16
+    rng = np.random.default_rng(1)
+    q, _, _, bias, mask = _dk_inputs()
+    k8 = jnp.asarray(rng.integers(-127, 128, (b, L, h, d)), jnp.int8)
+    v8 = jnp.asarray(rng.integers(-127, 128, (b, L, h, d)), jnp.int8)
+    shape = (b, L, h, 1) if kind == "pos" else (b, 1, h, d)
+    ks = jnp.asarray(rng.uniform(0.001, 0.02, shape), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.001, 0.02, shape), jnp.float32)
+    got = decode_attention(q, k8, v8, bias=bias, kv_mask=mask,
+                           k_scale=ks, v_scale=vs, block_k=32)
+    want = decode_attention_reference(q, k8, v8, bias=bias, kv_mask=mask,
+                                      k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_attention_rejects_bad_shapes():
+    from tpu_air.ops.decode_attention import decode_attention
+
+    q, k, v, _, _ = _dk_inputs()
+    with pytest.raises(ValueError, match="qlen==1"):
+        decode_attention(jnp.concatenate([q, q], axis=1), k, v)
+    with pytest.raises(ValueError, match="neither per-position"):
+        decode_attention(q, k, v, k_scale=jnp.ones((3, 2, 4, 16)))
+    with pytest.raises(ValueError, match="must divide"):
+        decode_attention(q, k, v, block_k=7)
+
+
+def test_t5_decode_pallas_generate_matches_einsum():
+    """End-to-end dispatch: greedy generation with
+    decode_attention_impl="pallas" must be token-identical to the einsum
+    decode path, for bf16-class AND int8 caches (the kernel replaces both
+    the self- and cross-attention cached steps)."""
+    import dataclasses
+
+    from tpu_air.models.t5.config import T5Config
+    from tpu_air.models.t5.generate import generate
+    from tpu_air.models.t5.modeling import T5ForConditionalGeneration
+
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    rng = jax.random.PRNGKey(0)
+    enc = jnp.ones((2, 8), jnp.int32)
+    params = model.init(rng, enc, jnp.ones_like(enc),
+                        jnp.ones((2, 6), jnp.int32))["params"]
+    ids = jnp.array([[4, 5, 6, 1, 0, 0], [7, 8, 9, 2, 1, 0]], jnp.int32)
+    mask = (ids != 0).astype(jnp.int32)
+    for int8 in (False, True):
+        outs = {}
+        for impl in ("einsum", "auto", "pallas"):
+            c = dataclasses.replace(
+                cfg, decode_attention_impl=impl, decode_cache_int8=int8)
+            m = T5ForConditionalGeneration(c)
+            outs[impl] = np.asarray(generate(m, params, ids, mask,
+                                             max_new_tokens=6))
+        np.testing.assert_array_equal(outs["einsum"], outs["auto"],
+                                      err_msg=f"int8={int8}")
+        np.testing.assert_array_equal(outs["einsum"], outs["pallas"],
+                                      err_msg=f"int8={int8}")

@@ -90,29 +90,49 @@ class CausalSelfAttention(nn.Module):
         if decode:
             # KV-cache path (autoregressive generate, SURVEY.md §7
             # hard-part 2): keys/values land at the running cache index via
-            # dynamic_update_slice; attention is dense over the cache with
-            # the query offset at the index, so the SAME call handles both
-            # the multi-token prefill and 1-token decode steps.  Cached k is
+            # dynamic_update_slice; the SAME call handles both the
+            # multi-token prefill and 1-token decode steps.  Cached k is
             # already RoPE'd (positions are global — the caller derives them
-            # from the cache index).
+            # from the cache index).  Slabs are stored FLAT [b, L, h*d]:
+            # the r5 T5 profile measured the [.., L, d=64] layout at 2x
+            # physical HBM bytes from (8, 128) tile padding; h*d is
+            # unpadded, and the 1-token step attends via the flat block-
+            # diagonal formulation (ops/decode_attention.py) that streams
+            # the slab once in storage layout.
             max_len = cfg.max_seq_len
             ck = self.variable(
                 "cache", "cached_key",
-                lambda: jnp.zeros((b, h, max_len, d), dtype))
+                lambda: jnp.zeros((b, max_len, h * d), dtype))
             cv = self.variable(
                 "cache", "cached_value",
-                lambda: jnp.zeros((b, h, max_len, d), dtype))
+                lambda: jnp.zeros((b, max_len, h * d), dtype))
             idx = self.variable(
                 "cache", "cache_index", lambda: jnp.array(0, jnp.int32))
             i = idx.value
+            kflat = k.transpose(0, 2, 1, 3).reshape(b, l, h * d)
+            vflat = v.transpose(0, 2, 1, 3).reshape(b, l, h * d)
             ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(dtype), (0, 0, i, 0))
+                ck.value, kflat.astype(dtype), (0, i, 0))
             cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(dtype), (0, 0, i, 0))
+                cv.value, vflat.astype(dtype), (0, i, 0))
             idx.value = i + l
-            # future cache slots are zeros but kj > qi masks them out
-            o = _dense_causal_attention(q, ck.value, cv.value, scale,
-                                        q_offset=i)
+            if l == 1:
+                from tpu_air.ops.decode_attention import flat_decode_attention
+
+                # future cache slots are zeros; the kv_mask hides them
+                kvm = jnp.broadcast_to(
+                    (jnp.arange(max_len) <= i)[None], (b, max_len))
+                o4 = flat_decode_attention(
+                    q.transpose(0, 2, 1, 3) * scale, ck.value, cv.value,
+                    None, kvm, None, None, h, dtype)
+                return proj("o", cfg.d_model)(o4.reshape(b, 1, h * d))
+            # prefill (and any multi-token window): dense attention over
+            # the cache with the query offset at the index — a one-time
+            # 4-D view per generate call.  Future slots are zeros but
+            # kj > qi masks them out.
+            ck4 = ck.value.reshape(b, max_len, h, d).transpose(0, 2, 1, 3)
+            cv4 = cv.value.reshape(b, max_len, h, d).transpose(0, 2, 1, 3)
+            o = _dense_causal_attention(q, ck4, cv4, scale, q_offset=i)
             o = o.transpose(0, 2, 1, 3).reshape(b, l, h * d)
             return proj("o", cfg.d_model)(o)
 

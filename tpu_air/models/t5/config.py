@@ -56,6 +56,15 @@ class T5Config:
     # default — the reference decodes fp16 (cc-64); numerics parity is
     # tested at tolerance in tests/test_t5.py.
     decode_cache_int8: bool = False
+    # Cached-decode attention dispatch (ops/decode_attention.py).  Caches
+    # are stored FLAT [b, L, h*d] (the 4-D layout cost 2.67x physical HBM
+    # bytes to tile padding — the r5 decode bottleneck).  "auto" = the
+    # flat block-diagonal XLA formulation (measured 89% of the v5e HBM
+    # roofline; the default everywhere — it is pure XLA and runs on CPU
+    # too); "pallas" = the fused kernel (measured slower; kept as the
+    # measured alternative, interpret mode off-TPU); "einsum" = the
+    # legacy dense path reconstructed from the flat slabs (comparison).
+    decode_attention_impl: str = "auto"
 
     def __post_init__(self):
         if self.num_decoder_layers is None:

@@ -156,53 +156,81 @@ class Attention(nn.Module):
         def _dequant(q8, scale):
             return (q8.astype(jnp.float32) * scale).astype(dtype)
 
+        # Decode caches are stored FLAT: [b, L, h*d], scales [b, 1, h*d]
+        # (cross, per-channel) / [b, L, h] (self, per-position).  The r5
+        # profile found the 4-D [b, L, h, d] slab layout was the decode
+        # bottleneck: TPU tiles the last two dims (12, 64) up to (16, 128)
+        # — 2.67x physical HBM bytes — and XLA streamed those padded
+        # slabs at ~92% of the roofline, i.e. the chip was fast, the
+        # LAYOUT was the waste.  h*d = 768 is six clean (8, 128) tiles,
+        # zero padding.  The cached single-token step then attends via
+        # the flat block-diagonal formulation (``flat_decode_attention``)
+        # or the Pallas kernel, never materializing a [b, L, h, d] copy.
+        dk_impl = getattr(cfg, "decode_attention_impl", "auto")
+        dk_scales = (None, None)
+        cached_step = False    # k/v hold FLAT cache slabs, not [b,k,h,d]
+
         if cross_decode and self.has_variable("cache", "cached_key"):
             # Cross-attention during cached decode: K/V are an invariant of
             # the encoder output, computed ONCE at cache init.  Recomputing
             # the two 512-token projections per decode step was the dominant
             # cost of W3 generation (~12 layers x 2 projections x the full
             # encoder length, per emitted token).
-            k = self.get_variable("cache", "cached_key")
+            k = self.get_variable("cache", "cached_key")       # [b, L, h*d]
             v = self.get_variable("cache", "cached_value")
+            cached_step = True
             if cache_int8:
-                k = _dequant(k, self.get_variable("cache", "cached_key_scale"))
-                v = _dequant(v, self.get_variable("cache", "cached_value_scale"))
+                dk_scales = (
+                    self.get_variable("cache", "cached_key_scale"),
+                    self.get_variable("cache", "cached_value_scale"),
+                )
         else:
             k = dense("k")(kv_hidden)    # [b, k, h, d]
             v = dense("v")(kv_hidden)
             if cross_decode:
+                bsz, klv = k.shape[0], k.shape[1]
                 if cache_int8:
                     kq, ks = _quant(k)
                     vq, vs = _quant(v)
-                    self.variable("cache", "cached_key", lambda: kq)
-                    self.variable("cache", "cached_key_scale", lambda: ks)
-                    self.variable("cache", "cached_value", lambda: vq)
-                    self.variable("cache", "cached_value_scale", lambda: vs)
+                    self.variable("cache", "cached_key",
+                                  lambda: kq.reshape(bsz, klv, -1))
+                    self.variable("cache", "cached_key_scale",
+                                  lambda: ks.reshape(bsz, 1, -1))
+                    self.variable("cache", "cached_value",
+                                  lambda: vq.reshape(bsz, klv, -1))
+                    self.variable("cache", "cached_value_scale",
+                                  lambda: vs.reshape(bsz, 1, -1))
                     # the init pass itself attends with the dequantized
                     # values so its output matches later steps
                     k = _dequant(kq, ks)
                     v = _dequant(vq, vs)
                 else:
-                    self.variable("cache", "cached_key", lambda: k)
-                    self.variable("cache", "cached_value", lambda: v)
+                    self.variable("cache", "cached_key",
+                                  lambda: k.reshape(bsz, klv, -1))
+                    self.variable("cache", "cached_value",
+                                  lambda: v.reshape(bsz, klv, -1))
 
         if decode:
-            # Cache layout [b, max_len, h, d]; cache vars are created ahead of
-            # time by init_cache (eval_shape) so is_init only occurs there.
-            # With decode_cache_int8 the slabs are int8 with a per-(batch,
-            # position, head) scale over the channel dim, quantized
-            # incrementally as each step's K/V land — the self-attention
-            # half of the decode-bandwidth story (cross is quantized whole
-            # at cache init above).
+            # Pre-allocated flat self-attention slabs; cache vars are
+            # created ahead of time by init_cache (eval_shape) so is_init
+            # only occurs there.  With decode_cache_int8 the slabs are
+            # int8 with a per-(batch, position, head) scale over the
+            # channel dim, quantized incrementally as each step's K/V
+            # land — the self-attention half of the decode-bandwidth
+            # story (cross is quantized whole at cache init above).
             is_init = not self.has_variable("cache", "cached_key")
             slab_dtype = jnp.int8 if cache_int8 else dtype
-            ck = self.variable("cache", "cached_key", jnp.zeros, k.shape, slab_dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros, v.shape, slab_dtype)
+            bsz, klv = k.shape[0], k.shape[1]
+            hd = cfg.num_heads * cfg.d_kv
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (bsz, klv, hd), slab_dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (bsz, klv, hd), slab_dtype)
             if cache_int8:
                 cks = self.variable("cache", "cached_key_scale", jnp.zeros,
-                                    k.shape[:-1] + (1,), jnp.float32)
+                                    (bsz, klv, cfg.num_heads), jnp.float32)
                 cvs = self.variable("cache", "cached_value_scale", jnp.zeros,
-                                    v.shape[:-1] + (1,), jnp.float32)
+                                    (bsz, klv, cfg.num_heads), jnp.float32)
             idx = self.variable(
                 "cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32)
             )
@@ -218,18 +246,25 @@ class Attention(nn.Module):
 
                     k8, ks_ = _quant_pos(k)
                     v8, vs_ = _quant_pos(v)
-                    ck.value = jax.lax.dynamic_update_slice(ck.value, k8, (0, cur, 0, 0))
-                    cks.value = jax.lax.dynamic_update_slice(cks.value, ks_, (0, cur, 0, 0))
-                    cv.value = jax.lax.dynamic_update_slice(cv.value, v8, (0, cur, 0, 0))
-                    cvs.value = jax.lax.dynamic_update_slice(cvs.value, vs_, (0, cur, 0, 0))
-                    idx.value = cur + q.shape[1]
-                    k = _dequant(ck.value, cks.value)
-                    v = _dequant(cv.value, cvs.value)
-                else:
-                    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
-                    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+                    ck.value = jax.lax.dynamic_update_slice(
+                        ck.value, k8.reshape(bsz, klv, hd), (0, cur, 0))
+                    cks.value = jax.lax.dynamic_update_slice(
+                        cks.value, ks_.reshape(bsz, klv, -1), (0, cur, 0))
+                    cv.value = jax.lax.dynamic_update_slice(
+                        cv.value, v8.reshape(bsz, klv, hd), (0, cur, 0))
+                    cvs.value = jax.lax.dynamic_update_slice(
+                        cvs.value, vs_.reshape(bsz, klv, -1), (0, cur, 0))
                     idx.value = cur + q.shape[1]
                     k, v = ck.value, cv.value
+                    dk_scales = (cks.value, cvs.value)
+                else:
+                    ck.value = jax.lax.dynamic_update_slice(
+                        ck.value, k.reshape(bsz, klv, hd), (0, cur, 0))
+                    cv.value = jax.lax.dynamic_update_slice(
+                        cv.value, v.reshape(bsz, klv, hd), (0, cur, 0))
+                    idx.value = cur + q.shape[1]
+                    k, v = ck.value, cv.value
+                cached_step = True
 
         qlen, klen = q.shape[1], k.shape[1]
         # Pallas blockwise path: eligible when callers passed the structured
@@ -258,7 +293,74 @@ class Attention(nn.Module):
             )
         else:
             use_flash = eligible and impl == "flash"
-        if use_flash:
+        if cached_step:
+            # Single-token step over flat cache slabs.  "auto"/"flat" is
+            # the XLA block-diagonal formulation (measured 732 GB/s = 89%
+            # of the v5e HBM roofline, r5); "pallas" is the fused kernel
+            # (ops/decode_attention.py — measured slower, 229 GB/s, kept
+            # as the measured alternative); "einsum" reconstructs the 4-D
+            # dense path for comparison.  Structured-mask contract: mask
+            # here is batch-shared (decode causal row) or None.
+            fast_ok = (
+                qlen == 1
+                and (deterministic or cfg.dropout_rate == 0)
+                and (mask is None or mask.shape[0] == 1)
+                and dk_impl != "einsum"
+            )
+            if fast_ok:
+                bias_arg = None
+                if position_bias is not None or mask is not None:
+                    comb = jnp.zeros((1, 1, 1, klen), jnp.float32)
+                    if position_bias is not None:
+                        comb = comb + position_bias.astype(jnp.float32)
+                    if mask is not None:
+                        comb = comb + mask.astype(jnp.float32)
+                    # batch-shared [1, h|1, 1, klen] -> [h, klen]
+                    bias_arg = jnp.broadcast_to(
+                        comb[0, :, 0, :], (cfg.num_heads, klen)
+                    )
+                if dk_impl == "pallas":
+                    from tpu_air.ops.decode_attention import decode_attention
+
+                    ctx = decode_attention(
+                        q, k, v, bias=bias_arg, kv_mask=kv_mask,
+                        k_scale=dk_scales[0], v_scale=dk_scales[1],
+                    )
+                else:
+                    from tpu_air.ops.decode_attention import (
+                        flat_decode_attention,
+                    )
+
+                    ctx = flat_decode_attention(
+                        q, k, v, bias_arg, kv_mask,
+                        dk_scales[0], dk_scales[1], cfg.num_heads, dtype,
+                    )
+            else:
+                # legacy/comparison path: materialize the dequantized 4-D
+                # slab and fall through to the dense einsum below
+                bsz = k.shape[0]
+                hpd = (cfg.num_heads, cfg.d_kv)
+                ks_, vs_ = dk_scales
+                if ks_ is not None:
+                    if ks_.shape[1] == 1:          # cross: per-channel
+                        k = (k.astype(jnp.float32) * ks_).reshape(
+                            bsz, klen, *hpd).astype(dtype)
+                        v = (v.astype(jnp.float32) * vs_).reshape(
+                            bsz, klen, *hpd).astype(dtype)
+                    else:                           # self: per-position
+                        k = (k.reshape(bsz, klen, *hpd).astype(jnp.float32)
+                             * ks_[..., None]).astype(dtype)
+                        v = (v.reshape(bsz, klen, *hpd).astype(jnp.float32)
+                             * vs_[..., None]).astype(dtype)
+                else:
+                    k = k.reshape(bsz, klen, *hpd)
+                    v = v.reshape(bsz, klen, *hpd)
+                ctx = None
+        else:
+            ctx = None
+        if ctx is not None:
+            pass
+        elif use_flash:
             from tpu_air.ops import flash_attention
 
             # position_bias stays (1, H, q, k) — the kernel's BlockSpec
