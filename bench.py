@@ -582,13 +582,14 @@ def _decode_step_bytes(config, batch, enc_len, max_decode_len) -> dict:
         "total_bytes": cross_kv + self_kv + params_b,
     }
     if int8_cache:
-        # honest caveat: the reduced cross AND self slab bytes both assume
-        # XLA fuses the dequant multiply into the attention einsum operand
-        # load; if it materializes the dequantized bf16/f32 K/V instead,
-        # real traffic is HIGHER than this model and the roofline fraction
-        # overstates efficiency.  A materialization-pessimistic upper bound
-        # (every int8 slab re-expanded to full-width each step) is reported
-        # alongside the fused lower bound.
+        # honest caveat: the reduced cross AND self slab bytes assume no
+        # dequantized slab is materialized.  On the default flat decode
+        # path (decode_attention_impl="auto"/"pallas") that holds BY
+        # CONSTRUCTION — scales fold into q/scores/probs/context, never a
+        # slab-wide multiply.  On the legacy "einsum" comparison path XLA
+        # may materialize the widened K/V; the materialization-pessimistic
+        # upper bound (every int8 slab re-expanded full-width each step)
+        # is reported alongside for that case.
         out["assumes_fused_dequant"] = True
         cross_kv_wide = 2 * batch * enc_len * h_d * bytes_el * layers
         self_kv_wide = 2 * batch * max_decode_len * h_d * bytes_el * layers
@@ -719,6 +720,143 @@ def _measure_int8_agreement(config, params, batch=256, enc_len=512,
     }
 
 
+def _measure_serve(n_requests: int = 300, concurrency: int = 8,
+                   port: int = 8973) -> dict:
+    """Serve-plane performance (VERDICT r4 #7): requests/sec and p50/p99
+    latency through the full HTTP proxy -> replica-actor -> Predictor
+    path, for a real HistGBDT checkpoint, num_replicas 1 vs 2
+    (Introduction_to_Ray_AI_Runtime.ipynb:cc-71,74).
+
+    Host-side only: worker env is pinned to XLA:CPU (and the axon plugin
+    gate removed) BEFORE tpu_air.init so serve replicas can never touch
+    the single tunnel chip this bench child owns — a replica initializing
+    the tunnel concurrently is the wedge the bench lock exists to
+    prevent.  The T5-generate-on-chip serve row therefore needs a second
+    chip; recorded as environment-blocked in BASELINE.md."""
+    import json as _json
+    import urllib.request
+
+    import numpy as np
+
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        import tpu_air
+        from tpu_air import serve
+        from tpu_air.predict.predictors import GBDTPredictor
+        from tpu_air.serve import PredictorDeployment, pandas_read_json
+        from tpu_air.train import Checkpoint
+        from tpu_air.train.hist_gbdt import HistGBDT
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((512, 20))
+        w = rng.standard_normal(20)
+        y = ((X @ w + 0.3 * rng.standard_normal(512)) > 0).astype(np.float64)
+        booster = HistGBDT(max_depth=3, max_bins=64)
+        booster.setup(X, y)
+        for _ in range(20):
+            booster.fit_one_round()
+        ckpt = Checkpoint.from_model(
+            extras={"sklearn_model": booster.scoring_copy()})
+
+        tpu_air.init(num_cpus=4)
+        body = _json.dumps(
+            [{f"f{j}": float(X[i, j]) for j in range(20)} for i in range(8)]
+        ).encode()
+        url = f"http://127.0.0.1:{port}/gbdt"
+
+        def one_request():
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            resp = urllib.request.urlopen(req, timeout=30)
+            resp.read()
+            return time.perf_counter() - t0
+
+        out: dict = {"model": "hist-gbdt (20 trees, depth 3, 20 features)",
+                     "rows_per_request": 8, "n_requests": n_requests,
+                     "concurrency": concurrency,
+                     # replica scaling is host-core-bound: on a 1-core CI
+                     # host 2 replicas cannot beat 1 (GIL-free processes,
+                     # but one core runs them all)
+                     "host_cpus": os.cpu_count()}
+        try:
+            for replicas in (1, 2):
+                serve.run(
+                    PredictorDeployment.options(
+                        name="GBDTService", num_replicas=replicas,
+                        route_prefix="/gbdt",
+                    ).bind(GBDTPredictor, ckpt, http_adapter=pandas_read_json),
+                    port=port,
+                )
+                for _ in range(10):
+                    one_request()  # warm replicas + proxy
+                # latency: sequential, per-request
+                lats = sorted(one_request() for _ in range(n_requests))
+                # throughput: closed-loop concurrent clients.  Failed
+                # requests must not inflate the number: only COMPLETED
+                # requests count, and failures are published.
+                import threading
+
+                done = []
+                errors = []
+                lock = threading.Lock()
+
+                def client(n):
+                    for _ in range(n):
+                        try:
+                            d = one_request()
+                        except Exception as e:  # noqa: BLE001 — published
+                            with lock:
+                                errors.append(f"{type(e).__name__}: {e}")
+                            continue
+                        with lock:
+                            done.append(d)
+
+                per_client = n_requests // concurrency
+                t0 = time.perf_counter()
+                ts = [threading.Thread(target=client, args=(per_client,))
+                      for _ in range(concurrency)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                wall = time.perf_counter() - t0
+                n = len(lats)
+                row = {
+                    "p50_ms": round(lats[n // 2] * 1e3, 2),
+                    "p99_ms": round(
+                        lats[max(0, math.ceil(0.99 * n) - 1)] * 1e3, 2),
+                    "requests_per_sec": round(len(done) / wall, 1),
+                }
+                if errors:
+                    row["throughput_errors"] = len(errors)
+                    row["first_error"] = errors[0]
+                out[f"replicas_{replicas}"] = row
+                serve.shutdown()
+            return out
+        finally:
+            # leftover proxy/replica/worker processes would contend with
+            # every later bench section on this box — tear down even when
+            # a request in the measurement loop raised
+            try:
+                serve.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            try:
+                tpu_air.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _measure_matmul_ceiling(iters: int = 64) -> dict:
     """Pure-matmul MFU at the W1 train step's own GEMM shapes (and one
     fat square as the chip's best case).  Each probe chains X @ B @ C back
@@ -836,6 +974,7 @@ def _child_main() -> None:
     int8_agreement = None
     segformer = segformer_error = None
     matmul_ceiling = None
+    serve_bench = None
     mfu_breakdown = None
     if on_tpu:
         try:
@@ -911,8 +1050,20 @@ def _child_main() -> None:
         except Exception as e:  # noqa: BLE001 — visible, never fatal
             matmul_ceiling = {"error": f"{type(e).__name__}: {e}"}
             print(f"matmul ceiling probe failed: {e}", file=sys.stderr)
+        try:
+            # serve-plane perf (host-side; replicas pinned to XLA:CPU)
+            if budget_left("serve"):
+                serve_bench = _measure_serve()
+        except Exception as e:  # noqa: BLE001 — visible, never fatal
+            serve_bench = {"error": f"{type(e).__name__}: {e}"}
+            print(f"serve bench failed: {e}", file=sys.stderr)
     else:
         # CPU smoke keeps the sections' code paths exercised at tiny dials
+        try:
+            serve_bench = _measure_serve(n_requests=24, concurrency=2)
+        except Exception as e:  # noqa: BLE001 — visible, never fatal
+            serve_bench = {"error": f"{type(e).__name__}: {e}"}
+            print(f"serve bench failed: {e}", file=sys.stderr)
         try:
             segformer = _measure_segformer(batch=2, img=64, steps_short=2,
                                            on_tpu=False)
@@ -1038,6 +1189,8 @@ def _child_main() -> None:
         result["generation_int8_agreement"] = int8_agreement
     if matmul_ceiling is not None:
         result["matmul_ceiling"] = matmul_ceiling
+    if serve_bench is not None:
+        result["serve"] = serve_bench
     if skipped_sections:
         result["sections_skipped_for_budget"] = skipped_sections
     print(json.dumps(result), flush=True)
