@@ -671,52 +671,120 @@ def _measure_generation(model, config, params, batch=256, enc_len=512,
 
 
 def _measure_int8_agreement(config, params, batch=256, enc_len=512,
-                            max_new_tokens=128) -> dict:
-    """int8-cache quality gate at scale (VERDICT r4 #4): greedy generation
-    with bf16 caches vs int8 caches over ``batch`` prompts at the W3
-    dials — exact-token agreement rate and first-divergence stats.
+                            steps=24, train_steps=48) -> dict:
+    """int8-cache quality gate at the W3 dials (VERDICT r4 #4), measured
+    so the number is meaningful WITHOUT a real checkpoint (this image has
+    no network egress and no cached flan-t5-base weights):
 
-    Environment limit, stated plainly: this image has no network egress
-    and no cached flan-t5-base weights, so the comparison runs the
-    flan-t5-base ARCHITECTURE with random-init parameters.  Random logits
-    cluster tighter than trained ones, which makes argmax MORE
-    quantization-sensitive, so the agreement rate here is a conservative
-    structural gate, not a claim about trained-model quality."""
+    * The flan-t5-base-dims model is first fine-tuned for ``train_steps``
+      real optimizer steps so logits peak away from random-init's
+      near-uniform distribution.  (The r5 first-cut free-running gate on
+      raw random init measured 1% token agreement with median first
+      divergence at token 1 — that is argmax instability of ~uniform
+      logits plus chain divergence, not quantization quality.)
+    * The comparison is TEACHER-FORCED: both cache variants decode along
+      the SAME token path (the bf16 variant's greedy choices), so each
+      step scores argmax agreement against an IDENTICAL context instead
+      of compounding the first divergence forever.
+
+    Reports per-(step, row) forced agreement plus the bf16 top1-top2
+    logit-margin distribution (how decisive the argmaxes being compared
+    are).  int8 stays opt-in; this section is its standing evidence."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+    import optax
 
-    from tpu_air.models.t5 import T5Config, T5ForConditionalGeneration
-    from tpu_air.models.t5.generate import make_generate_fn
+    from tpu_air.models.t5 import (
+        T5Config, T5ForConditionalGeneration, cross_entropy_loss, shift_right,
+    )
+    from tpu_air.models.t5.generate import init_cache, make_generate_fn
 
     rng = jax.random.PRNGKey(3)
     ids = jax.random.randint(rng, (batch, enc_len), 2, config.vocab_size,
                              jnp.int32)
     mask = jnp.ones((batch, enc_len), jnp.int32)
-    outs = {}
-    for int8 in (False, True):
-        c = T5Config.from_dict({**config.to_dict(),
-                                "decode_cache_int8": int8})
-        m = T5ForConditionalGeneration(c)
-        fn = make_generate_fn(m, max_new_tokens, False, 1.0, 0,
-                              early_stop=False)
-        outs[int8] = np.asarray(fn(params, ids, mask, rng)[0])
-    a, b = outs[False], outs[True]
-    eq = a == b
-    seq_exact = eq.all(axis=1)
-    # first index where the two decodes diverge, per sequence (=max_new
-    # when they never do)
-    first_div = np.where(seq_exact, max_new_tokens, eq.argmin(axis=1))
+
+    # -- brief real fine-tune to peak the logits ---------------------------
+    model = T5ForConditionalGeneration(config)
+    labels = jax.random.randint(jax.random.PRNGKey(5), (batch // 8, 64),
+                                2, config.vocab_size, jnp.int32)
+    t_ids, t_mask = ids[: batch // 8, :128], mask[: batch // 8, :128]
+    tx = optax.adamw(3e-4)
+
+    def train_step(carry, _):
+        p, o = carry
+
+        def loss_fn(pp):
+            dec_in = shift_right(labels, config.decoder_start_token_id,
+                                 config.pad_token_id)
+            logits = model.apply({"params": pp}, t_ids, t_mask, dec_in,
+                                 deterministic=True)
+            return cross_entropy_loss(logits, labels, config.pad_token_id)[0]
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        return (optax.apply_updates(p, updates), o), loss
+
+    @jax.jit
+    def train(p, o):
+        (p, o), losses = jax.lax.scan(train_step, (p, o), None,
+                                      length=train_steps)
+        return p, losses[-1]
+
+    params_t, final_loss = train(params, tx.init(params))
+    params_t = jax.block_until_ready(params_t)
+
+    # -- the bf16 variant's greedy path is the forcing sequence ------------
+    fn = make_generate_fn(model, steps, False, 1.0, 0, early_stop=False)
+    forced = fn(params_t, ids, mask, rng)[0]          # [b, steps]
+    start_tok = jnp.full((batch, 1), config.decoder_start_token_id,
+                         jnp.int32)
+    inputs = jnp.concatenate([start_tok, forced[:, :-1]], axis=1)  # [b, T]
+
+    # the encoder output is an invariant across cache variants (int8 only
+    # changes decoder caches) — compute it once
+    enc_hidden = model.apply({"params": params_t}, ids, mask,
+                             method=model.encode)
+
+    def forced_decode(cfg_variant):
+        m = T5ForConditionalGeneration(cfg_variant)
+        cache = init_cache(m, params_t, batch, steps + 1, enc_hidden, mask)
+
+        @jax.jit
+        def run(cache):
+            def step(cache, tok):
+                logits, vars_ = m.apply(
+                    {"params": params_t, "cache": cache}, tok[:, None],
+                    enc_hidden, mask, decode=True, mutable=["cache"],
+                    method=m.decode,
+                )
+                top2 = jax.lax.top_k(logits[:, -1].astype(jnp.float32), 2)[0]
+                return vars_["cache"], (jnp.argmax(logits[:, -1], axis=-1),
+                                        top2[:, 0] - top2[:, 1])
+            _, (am, margin) = jax.lax.scan(step, cache, inputs.T)
+            return am, margin                          # [T, b] each
+
+        return run(cache)
+
+    am_a, margin = forced_decode(config)
+    cfg8 = T5Config.from_dict({**config.to_dict(), "decode_cache_int8": True})
+    am_b, _ = forced_decode(cfg8)
+    agree = np.asarray(am_a == am_b)
+    margin = np.asarray(margin)
     return {
         "batch": batch,
         "enc_len": enc_len,
-        "max_new_tokens": max_new_tokens,
-        "weights": "random-init flan-t5-base dims (no egress for real "
-                   "checkpoint; see docstring)",
-        "token_agreement": round(float(eq.mean()), 4),
-        "seq_exact_match": round(float(seq_exact.mean()), 4),
-        "first_divergence_median": int(np.median(first_div)),
-        "first_divergence_p10": int(np.percentile(first_div, 10)),
+        "steps": steps,
+        "train_steps": train_steps,
+        "final_train_loss": round(float(final_loss), 3),
+        "weights": "flan-t5-base dims, briefly fine-tuned in place (no "
+                   "egress for a real checkpoint; see docstring)",
+        "methodology": "teacher-forced along the bf16 greedy path",
+        "forced_token_agreement": round(float(agree.mean()), 4),
+        "rows_fully_agreeing": round(float(agree.all(axis=0).mean()), 4),
+        "bf16_top2_margin_p10": round(float(np.percentile(margin, 10)), 4),
+        "bf16_top2_margin_median": round(float(np.median(margin)), 4),
     }
 
 
@@ -859,12 +927,14 @@ def _measure_serve(n_requests: int = 300, concurrency: int = 8,
 
 def _measure_matmul_ceiling(iters: int = 64) -> dict:
     """Pure-matmul MFU at the W1 train step's own GEMM shapes (and one
-    fat square as the chip's best case).  Each probe chains X @ B @ C back
-    to X's shape inside a fori_loop, so the loop body is two back-to-back
-    MXU matmuls with no host round-trips; achieved TFLOPs / peak bounds
-    what ANY schedule of this model could reach — the measurement that
-    says whether train-step MFU 0.50 is kernel inefficiency or the
-    compute floor at d_model=768 (VERDICT r4 #2)."""
+    fat square as the chip's best case).  Methodology: each iteration
+    multiplies a FRESH lhs (streamed from an HBM stack — no operand
+    dependency between iterations, so the MXU sees back-to-back
+    independent matmuls) against resident rhs, with the output consumed
+    by a fused reduce.  The r5 first cut chained X @ B @ C through a
+    carry and measured 0.15-0.55 of peak — serial dependence plus carry
+    spills, not the chip's ceiling; this version is the honest bound on
+    what ANY schedule could reach per shape (VERDICT r4 #2)."""
     import jax
     import jax.numpy as jnp
 
@@ -882,21 +952,31 @@ def _measure_matmul_ceiling(iters: int = 64) -> dict:
     rows = {}
     for label, (m, k, n) in shapes.items():
         key = jax.random.PRNGKey(0)
-        x = jax.random.normal(key, (m, k), jnp.bfloat16)
+        # stack depth bounded so the lhs stack stays well under HBM
+        depth = max(2, min(16, int(2e9 / (m * k * 2))))
+        xs = jax.random.normal(key, (depth, m, k), jnp.bfloat16)
         b = jax.random.normal(key, (k, n), jnp.bfloat16)
-        c = jax.random.normal(key, (n, k), jnp.bfloat16)
 
-        @jax.jit
-        def chain(x, b, c):
-            def body(_, y):
-                return (y @ b) @ c
+        def make(nit):
+            @jax.jit
+            def run(xs, b):
+                def body(i, acc):
+                    y = jax.lax.dynamic_index_in_dim(
+                        xs, i % depth, keepdims=False) @ b
+                    return acc + jnp.sum(y.astype(jnp.float32))
 
-            return jax.lax.fori_loop(0, iters, body, x)
+                return jax.lax.fori_loop(0, nit, body, jnp.float32(0.0))
 
-        jax.block_until_ready(chain(x, b, c))  # compile + warm
-        t = _med3(lambda: jax.block_until_ready(chain(x, b, c)))
-        flops = 2 * 2 * m * k * n * iters
-        tf = flops / t / 1e12
+            return run
+
+        short, long_ = make(iters), make(3 * iters)
+        float(short(xs, b))  # compile + warm
+        float(long_(xs, b))
+        t1 = _med3(lambda: float(short(xs, b)))
+        t3 = _med3(lambda: float(long_(xs, b)))
+        t = t3 - t1          # time of 2*iters, RTT cancelled
+        flops = 2 * m * k * n * 2 * iters
+        tf = flops / t / 1e12 if t > 0 else float("nan")
         rows[label] = {
             "tflops": round(tf, 1),
             "fraction_of_peak": round(tf * 1e12 / peak, 3) if peak else None,
@@ -991,22 +1071,20 @@ def _child_main() -> None:
             generation_error = f"{type(e).__name__}: {e}"
             print(f"generation bench failed: {generation_error}", file=sys.stderr)
         try:
-            # dense-einsum decode baseline, measured side-by-side with
-            # the flat block-diagonal path above (decode_attention_impl
-            # defaults to "auto" = flat) so the artifact shows the
-            # layout fix's delta.  NB: with caches now STORED flat, the
-            # "einsum" impl reconstructs the padded 4-D slab per step —
-            # it is the comparison path, not r4's native-4-D number
-            # (that lives in BENCH_r04.json).
-            if budget_left("generation_einsum"):
-                cfg_es = T5Config.from_dict({**config.to_dict(),
-                                             "decode_attention_impl": "einsum"})
+            # block-diagonal flat-formulation comparison, measured
+            # side-by-side with "auto" above (auto = dense-from-flat for
+            # bf16 per the r5 measurement: 179.2 vs 161.2 seq/s) so the
+            # dispatch choice stays pinned to data round over round.
+            # r4's native-4-D einsum number lives in BENCH_r04.json.
+            if budget_left("generation_flat"):
+                cfg_fl = T5Config.from_dict({**config.to_dict(),
+                                             "decode_attention_impl": "flat"})
                 generation_einsum = _measure_generation(
-                    T5ForConditionalGeneration(cfg_es), cfg_es, params
+                    T5ForConditionalGeneration(cfg_fl), cfg_fl, params
                 )
         except Exception as e:  # noqa: BLE001 — visible in the artifact
             generation_einsum_error = f"{type(e).__name__}: {e}"
-            print(f"einsum generation bench failed: {e}", file=sys.stderr)
+            print(f"flat generation bench failed: {e}", file=sys.stderr)
         try:
             # opt-in int8 cross-KV cache: halves the dominant decode HBM
             # term — measured side-by-side so the artifact shows the delta
@@ -1176,9 +1254,9 @@ def _child_main() -> None:
     if generation_int8_error:
         result["generation_int8_cache_error"] = generation_int8_error
     if generation_einsum is not None:
-        result["generation_einsum"] = generation_einsum
+        result["generation_flat_blockdiag"] = generation_einsum
     if generation_einsum_error:
-        result["generation_einsum_error"] = generation_einsum_error
+        result["generation_flat_blockdiag_error"] = generation_einsum_error
     if segformer is not None:
         result["segformer"] = segformer
     if segformer_error:

@@ -440,13 +440,12 @@ def test_t5_decode_pallas_generate_matches_einsum():
     mask = (ids != 0).astype(jnp.int32)
     for int8 in (False, True):
         outs = {}
-        for impl in ("einsum", "auto", "pallas"):
+        for impl in ("einsum", "auto", "flat", "pallas"):
             c = dataclasses.replace(
                 cfg, decode_attention_impl=impl, decode_cache_int8=int8)
             m = T5ForConditionalGeneration(c)
             outs[impl] = np.asarray(generate(m, params, ids, mask,
                                              max_new_tokens=6))
-        np.testing.assert_array_equal(outs["einsum"], outs["auto"],
-                                      err_msg=f"int8={int8}")
-        np.testing.assert_array_equal(outs["einsum"], outs["pallas"],
-                                      err_msg=f"int8={int8}")
+        for impl in ("auto", "flat", "pallas"):
+            np.testing.assert_array_equal(outs["einsum"], outs[impl],
+                                          err_msg=f"impl={impl} int8={int8}")

@@ -20,7 +20,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def slope_time(fn, q, k, v, steps=8, reps=3):
+def slope_time(fn, q, k, v, steps=512, reps=3):
+    # steps must be large enough that 2*steps of attention dwarf the
+    # ~66 ms tunnel round-trip, or the 25%-slope validity gate NaNs out
+    # (r5: steps=8 at seq 512 was ~3 ms of compute against 66 ms of RTT)
     import jax
     import jax.numpy as jnp
 
@@ -52,6 +55,7 @@ def main():
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--bh", type=int, default=48)
     ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=512)
     args = ap.parse_args()
 
     import jax
@@ -71,7 +75,7 @@ def main():
         p = jax.nn.softmax(s * (1.0 / D**0.5), axis=-1)
         return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
-    per = slope_time(dense, q, k, v)
+    per = slope_time(dense, q, k, v, steps=args.steps)
     print(f"dense: {per*1e3:8.3f} ms  {flops/per/1e12:6.1f} TF/s")
 
     candidates = [b for b in (64, 128, 256, 512) if L % b == 0]
@@ -82,7 +86,7 @@ def main():
                 return flash_attention(q, k, v, block_q=bq, block_k=bk)
 
             try:
-                per = slope_time(fn, q, k, v)
+                per = slope_time(fn, q, k, v, steps=args.steps)
             except Exception as e:  # noqa: BLE001
                 print(f"flash bq={bq:4d} bk={bk:4d}: FAILED {type(e).__name__}")
                 continue

@@ -58,12 +58,17 @@ class T5Config:
     decode_cache_int8: bool = False
     # Cached-decode attention dispatch (ops/decode_attention.py).  Caches
     # are stored FLAT [b, L, h*d] (the 4-D layout cost 2.67x physical HBM
-    # bytes to tile padding — the r5 decode bottleneck).  "auto" = the
-    # flat block-diagonal XLA formulation (measured 89% of the v5e HBM
-    # roofline; the default everywhere — it is pure XLA and runs on CPU
-    # too); "pallas" = the fused kernel (measured slower; kept as the
-    # measured alternative, interpret mode off-TPU); "einsum" = the
-    # legacy dense path reconstructed from the flat slabs (comparison).
+    # bytes to tile padding — the r5 decode bottleneck).  "auto" follows
+    # the BENCH r5 measurement at the W3 dials: full-width caches decode
+    # through XLA's dense path reconstructed from the flat slab (179.2
+    # seq/s, 0.80 of the v5e HBM roofline — XLA's own fusion wins once
+    # the carry layout is flat), int8 caches through the flat block-
+    # diagonal formulation whose scale FOLDS never materialize a
+    # dequantized slab (213.7 seq/s vs a 9.4 GB/step materialization
+    # bound).  Explicit values pin one path: "flat" = block-diagonal
+    # formulation; "einsum" = dense reconstruction; "pallas" = the fused
+    # kernel (measured slower — kept as the measured alternative,
+    # interpret mode off-TPU).
     decode_attention_impl: str = "auto"
 
     def __post_init__(self):

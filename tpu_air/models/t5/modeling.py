@@ -294,18 +294,26 @@ class Attention(nn.Module):
         else:
             use_flash = eligible and impl == "flash"
         if cached_step:
-            # Single-token step over flat cache slabs.  "auto"/"flat" is
-            # the XLA block-diagonal formulation (measured 732 GB/s = 89%
-            # of the v5e HBM roofline, r5); "pallas" is the fused kernel
-            # (ops/decode_attention.py — measured slower, 229 GB/s, kept
-            # as the measured alternative); "einsum" reconstructs the 4-D
-            # dense path for comparison.  Structured-mask contract: mask
-            # here is batch-shared (decode causal row) or None.
+            # Single-token step over flat cache slabs.  Structured-mask
+            # contract: mask here is batch-shared (decode causal row) or
+            # None.
+            # Measured dispatch (BENCH r5, W3 dials, flat storage): bf16
+            # decodes FASTER through XLA's dense path reconstructed from
+            # the flat slab (179.2 seq/s, 0.80 of roofline) than through
+            # the block-diagonal formulation (161.2, 0.715) — given the
+            # flat carry layout, XLA's own attention fusion wins.  int8
+            # must NOT reconstruct (dequant materializes, pessimistic
+            # bound 9.4 GB/step vs 3.3): the fold-based flat path wins
+            # there (213.7 seq/s).  So "auto" = einsum for full-width
+            # caches, flat folds for int8.
+            impl_eff = dk_impl
+            if dk_impl == "auto" and dk_scales[0] is None:
+                impl_eff = "einsum"
             fast_ok = (
                 qlen == 1
                 and (deterministic or cfg.dropout_rate == 0)
                 and (mask is None or mask.shape[0] == 1)
-                and dk_impl != "einsum"
+                and impl_eff != "einsum"
             )
             if fast_ok:
                 bias_arg = None

@@ -75,8 +75,13 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, mask_ref, out_ref, lse_ref,
 
     @pl.when(live)
     def _body():
-        q = q_ref[0].astype(jnp.float32)  # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)  # (BK, D)
+        # Matmul operands stay in the INPUT dtype (bf16 on chip runs the
+        # MXU at ~4x its f32 rate — the r5 tile sweep measured the f32
+        # kernel at 29 TF/s vs 80 for XLA dense at seq 512); accumulation
+        # and every softmax statistic remain f32, the same precision
+        # budget as the dense einsum path (bf16 operands, f32 softmax).
+        q = q_ref[0]  # (BQ, D)
+        k = k_ref[0]  # (BK, D)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (BQ, BK)
@@ -97,7 +102,7 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, mask_ref, out_ref, lse_ref,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -144,8 +149,15 @@ _BLOCK_CANDIDATES = (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
 # Measured on TPU v5e (BH=48, D=64, bf16, slope-timed): (128, 128) runs at
 # 6-8 TF/s while (512, 1024) reaches 48-80 TF/s — 3-5x FASTER than XLA's
 # dense path at L >= 2048 and ~parity at L = 512.  Bigger k tiles amortize
-# the per-block online-softmax rescale; bigger q tiles amortize k/v streams.
-_AUTO_BLOCK_Q_CAP = 512
+# the per-block online-softmax rescale; bigger q tiles amortize k/v
+# streams.  r5 re-sweep with bf16 matmul operands (halved VMEM tiles):
+# (1024, 1024) beats (512, 1024) at every length — 52.9 vs 49.0 TF/s at
+# L=1024, 62.7 vs 55.9 at 2048, 66.4 vs 57.7 at 4096 (4.26x dense);
+# (1024, 4096) and (2048, 2048) exceed VMEM.  The r5 512-seq tile sweep
+# (tools/tune_flash_tiles.py) also RE-confirmed the einsum crossover:
+# best flash tiling at L=512 is 29 TF/s vs 80 for XLA dense, so
+# flash_min_seq_len=1024 stands on data.
+_AUTO_BLOCK_Q_CAP = 1024
 _AUTO_BLOCK_K_CAP = 1024
 
 
@@ -322,9 +334,11 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
               i, j, scale, causal, block_q, block_k):
     """Shared per-tile backward computation: recompute scores with the SAME
     masking as the forward (single source of truth), then p and ds.
-    Returns (q, k, do, p, ds), all f32."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
+    Returns (q, k, do, p, ds): operands q/k/do in their INPUT dtype
+    (bf16 matmuls on chip — see the forward kernel's precision note),
+    p/ds f32."""
+    q = q_ref[0]
+    k = k_ref[0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -335,9 +349,9 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         kj = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(qi >= kj, s, _NEG_INF)
     p = _bwd_p(s, lse_ref[0])                        # (BQ, BK)
-    do = do_ref[0].astype(jnp.float32)               # (BQ, D)
+    do = do_ref[0]                                   # (BQ, D)
     dp = jax.lax.dot_general(
-        do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        do.astype(v_ref.dtype), v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                                # (BQ, BK)
     ds = p * (dp - delta_ref[0])
@@ -369,7 +383,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
                                    delta_ref, mask_ref, i, j, scale, causal,
                                    block_q, block_k)
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         ) * scale
 
     @pl.when(j == nk - 1)
@@ -401,10 +416,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
                                     delta_ref, mask_ref, i, j, scale, causal,
                                     block_q, block_k)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )                                            # (BK, D)
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         ) * scale                                    # (BK, D)
 
     @pl.when(i == nq - 1)
