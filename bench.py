@@ -748,24 +748,38 @@ def _measure_int8_agreement(config, params, batch=256, enc_len=512,
                              method=model.encode)
 
     def forced_decode(cfg_variant):
+        # one SMALL jitted single-step program + a Python loop, NOT a
+        # steps-long scan: the whole-loop scan compile reproducibly
+        # crashed the tunnel's AOT compile helper (broken pipe) at these
+        # dials, and the per-step program is the same class generate's
+        # while-loop body already compiles
         m = T5ForConditionalGeneration(cfg_variant)
         cache = init_cache(m, params_t, batch, steps + 1, enc_hidden, mask)
 
-        @jax.jit
-        def run(cache):
-            def step(cache, tok):
-                logits, vars_ = m.apply(
-                    {"params": params_t, "cache": cache}, tok[:, None],
-                    enc_hidden, mask, decode=True, mutable=["cache"],
-                    method=m.decode,
-                )
-                top2 = jax.lax.top_k(logits[:, -1].astype(jnp.float32), 2)[0]
-                return vars_["cache"], (jnp.argmax(logits[:, -1], axis=-1),
-                                        top2[:, 0] - top2[:, 1])
-            _, (am, margin) = jax.lax.scan(step, cache, inputs.T)
-            return am, margin                          # [T, b] each
+        # params/enc_hidden MUST be jit arguments, not closures: closed-
+        # over they bake ~1 GB of constants into the program, which
+        # reproducibly crashed the tunnel's AOT compile helper (broken
+        # pipe) — the same reason generate() threads params explicitly
+        from functools import partial
 
-        return run(cache)
+        @partial(jax.jit, donate_argnums=(2,))
+        def step_fn(params, enc_h, cache, tok):
+            logits, vars_ = m.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                enc_h, mask, decode=True, mutable=["cache"],
+                method=m.decode,
+            )
+            top2 = jax.lax.top_k(logits[:, -1].astype(jnp.float32), 2)[0]
+            return (vars_["cache"], jnp.argmax(logits[:, -1], axis=-1),
+                    top2[:, 0] - top2[:, 1])
+
+        ams, margins = [], []
+        for t in range(steps):
+            cache, am, mg = step_fn(params_t, enc_hidden, cache,
+                                    inputs[:, t])
+            ams.append(am)
+            margins.append(mg)
+        return jnp.stack(ams), jnp.stack(margins)     # [T, b] each
 
     am_a, margin = forced_decode(config)
     cfg8 = T5Config.from_dict({**config.to_dict(), "decode_cache_int8": True})
