@@ -1,0 +1,407 @@
+"""tpu_air.engine — continuous-batching online inference.
+
+Layers under test:
+  * scheduler / slot-manager host logic (no device work);
+  * the CPU token-parity gate: engine emitted tokens must be TOKEN-IDENTICAL
+    to offline greedy ``generate()`` on the same prompts, for burst,
+    staggered and trickle arrival schedules (ISSUE acceptance anchor);
+  * EOS + budget retirement and slot reuse;
+  * streaming + backpressure semantics;
+  * metrics / dashboard export;
+  * the T5 prefill/decode-step entry points;
+  * EngineDeployment over HTTP (503 on overload).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_air.engine import (
+    EngineConfig,
+    EngineOverloadedError,
+    InferenceEngine,
+    Request,
+    ResponseStream,
+    Scheduler,
+    SlotManager,
+)
+from tpu_air.models.lm import CausalLM, LMConfig
+from tpu_air.models.lm.generate import generate as lm_generate
+
+PORT = 8127
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = LMConfig.tiny()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def _prompts(seed, n, lo=3, hi=12, vocab=384):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(1, vocab, size=rng.randint(lo, hi))))
+            for _ in range(n)]
+
+
+def _offline(model, params, prompt, max_new, eos):
+    """Reference: offline greedy generate, truncated after the first EOS
+    (inclusive — the engine emits the EOS id then retires)."""
+    out = np.asarray(
+        lm_generate(model, params, [prompt], max_new_tokens=max_new,
+                    eos_token_id=eos)
+    )[0].tolist()
+    if eos is not None and eos in out:
+        out = out[: out.index(eos) + 1]
+    return out
+
+
+def _run_schedule(engine, arrivals):
+    """Drive a manual-step engine through a deterministic arrival schedule:
+    ``arrivals`` is a list of (engine_step, prompt); returns streams in
+    submission order."""
+    order = sorted(range(len(arrivals)), key=lambda i: arrivals[i][0])
+    streams = {}
+    t, i = 0, 0
+    while i < len(order) or not engine.idle():
+        while i < len(order) and arrivals[order[i]][0] <= t:
+            streams[order[i]] = engine.submit(arrivals[order[i]][1])
+            i += 1
+        engine.step()
+        t += 1
+    return [streams[j] for j in range(len(arrivals))]
+
+
+# ---------------------------------------------------------------------------
+# host-side units: scheduler, slots, config
+# ---------------------------------------------------------------------------
+
+
+def _req(rid, prompt=(1, 2, 3)):
+    return Request(request_id=rid, prompt=list(prompt), max_new_tokens=4,
+                   stream=ResponseStream(rid))
+
+
+def test_scheduler_fifo_order():
+    s = Scheduler(EngineConfig(max_queue=16))
+    for rid in range(5):
+        s.submit(_req(rid))
+    assert [r.request_id for r in s.pop_admissible(3)] == [0, 1, 2]
+    assert [r.request_id for r in s.pop_admissible(8)] == [3, 4]
+    assert s.depth() == 0
+
+
+def test_scheduler_backpressure():
+    s = Scheduler(EngineConfig(max_queue=2))
+    s.submit(_req(0))
+    s.submit(_req(1))
+    with pytest.raises(EngineOverloadedError):
+        s.submit(_req(2))
+    # draining reopens admission
+    assert len(s.pop_admissible(2)) == 2
+    s.submit(_req(3))
+    assert s.depth() == 1
+
+
+def test_slot_manager_lowest_row_and_reuse():
+    m = SlotManager(3)
+    a, b, c = m.acquire(), m.acquire(), m.acquire()
+    assert (a.index, b.index, c.index) == (0, 1, 2)
+    assert m.free_count() == 0 and m.occupancy() == 3
+    m.release(b)
+    assert m.free_count() == 1
+    assert m.acquire().index == 1  # freed row is handed out again
+    m.release(a)
+    m.release(c)
+    assert m.acquire().index == 0  # lowest free row first
+
+
+def test_engine_config_buckets():
+    cfg = EngineConfig(slot_len=48)
+    assert cfg.buckets() == (1, 2, 4, 8, 16, 32, 48)
+    assert cfg.bucket_for(5) == 8
+    assert cfg.bucket_for(48) == 48
+    with pytest.raises(ValueError):
+        cfg.bucket_for(49)
+
+
+# ---------------------------------------------------------------------------
+# the parity gate: engine tokens == offline greedy generate tokens
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,arrival_of",
+    [
+        ("burst", lambda i: 0),         # all at once, > num_slots deep
+        ("staggered", lambda i: i),     # one new request per engine step
+        ("trickle", lambda i: 4 * i),   # arrivals slower than completions
+    ],
+)
+def test_token_parity_with_offline_generate(lm, name, arrival_of):
+    """ISSUE acceptance: token-identical to offline greedy generate under
+    deterministic scheduling, for three arrival shapes."""
+    cfg, model, params = lm
+    prompts = _prompts(seed=7, n=7)
+    max_new = 10
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=3, slot_len=64, max_new_tokens=max_new),
+        auto_start=False,
+    )
+    arrivals = [(arrival_of(i), p) for i, p in enumerate(prompts)]
+    streams = _run_schedule(engine, arrivals)
+    for p, s in zip(prompts, streams):
+        assert s.result(5.0) == _offline(model, params, p, max_new, None)
+    engine.close()
+
+
+def test_token_parity_with_eos_retirement(lm):
+    """Early-stop path: rows retire the step they emit EOS (id included),
+    matching offline generate truncated after the first EOS."""
+    cfg, model, params = lm
+    prompts = _prompts(seed=11, n=6)
+    max_new = 12
+    # a realistic EOS: a token the greedy chain actually emits mid-stream
+    ref = _offline(model, params, prompts[0], max_new, None)
+    eos = ref[2]
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=2, slot_len=64, max_new_tokens=max_new,
+                     eos_token_id=eos),
+        auto_start=False,
+    )
+    streams = _run_schedule(engine, [(i, p) for i, p in enumerate(prompts)])
+    retired_early = 0
+    for p, s in zip(prompts, streams):
+        want = _offline(model, params, p, max_new, eos)
+        assert s.result(5.0) == want
+        if len(want) < max_new:
+            retired_early += 1
+    assert retired_early > 0, "EOS never triggered — test exercises nothing"
+    engine.close()
+
+
+def test_slot_reuse_burst_deeper_than_pool(lm):
+    """7 requests through a 2-slot pool: every slot is reused, and the
+    engine drains completely (no stuck slots, no lost requests)."""
+    cfg, model, params = lm
+    prompts = _prompts(seed=3, n=7)
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=2, slot_len=64, max_new_tokens=6),
+        auto_start=False,
+    )
+    streams = [engine.submit(p) for p in prompts]
+    steps = 0
+    while not engine.idle():
+        engine.step()
+        steps += 1
+        assert steps < 500, "engine failed to drain"
+    assert engine.slots.free_count() == 2
+    for p, s in zip(prompts, streams):
+        assert s.result(5.0) == _offline(model, params, p, 6, None)
+    assert engine.metrics.snapshot()["requests_completed"] == 7
+    engine.close()
+
+
+def test_submit_validation_and_backpressure(lm):
+    cfg, model, params = lm
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=1, slot_len=32, max_new_tokens=8, max_queue=2),
+        auto_start=False,
+    )
+    with pytest.raises(ValueError):
+        engine.submit([])
+    with pytest.raises(ValueError):
+        engine.submit(list(range(1, 30)), max_new_tokens=8)  # 29 + 8 > 32
+    engine.submit([1, 2, 3])
+    engine.submit([4, 5, 6])
+    with pytest.raises(EngineOverloadedError):
+        engine.submit([7, 8, 9])
+    assert engine.metrics.snapshot()["requests_rejected"] == 1
+    while not engine.idle():
+        engine.step()
+    engine.close()
+
+
+def test_streaming_background_thread(lm):
+    """Tokens arrive on the stream while the request is still decoding —
+    the per-token streaming contract, driven by the background loop."""
+    cfg, model, params = lm
+    with InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=2, slot_len=64, max_new_tokens=8),
+    ) as engine:
+        prompt = _prompts(seed=5, n=1)[0]
+        got = list(engine.submit(prompt))  # iterates until retirement
+        assert got == _offline(model, params, prompt, 8, None)
+        # convenience batch API on the same live engine
+        outs = engine.generate(_prompts(seed=6, n=3), max_new_tokens=5)
+        assert [len(o) for o in outs] == [5, 5, 5]
+
+
+def test_metrics_and_dashboard_export(lm):
+    cfg, model, params = lm
+    from tpu_air.observability.dashboard import _prometheus_text, engine_stats
+
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=2, slot_len=64, max_new_tokens=4),
+        auto_start=False, name="engine-test-metrics",
+    )
+    engine.generate(_prompts(seed=9, n=3))
+    snap = engine.metrics.snapshot()
+    assert snap["requests_submitted"] == 3
+    assert snap["requests_completed"] == 3
+    assert snap["tokens_emitted"] == 12
+    assert snap["slot_occupancy"] == 0 and snap["queue_depth"] == 0
+    assert snap["ttft_s"]["count"] == 3
+    assert snap["step_latency_s"]["count"] >= 1
+    # dashboard surfaces: /api/engines payload + prometheus text
+    assert "engine-test-metrics" in engine_stats()
+    text = _prometheus_text()
+    assert 'tpu_air_engine_tokens_emitted{engine="engine-test-metrics"} 12' in text
+    engine.close()
+    assert "engine-test-metrics" not in engine_stats()  # unregistered
+
+
+# ---------------------------------------------------------------------------
+# T5 continuous-decode entry points
+# ---------------------------------------------------------------------------
+
+
+def test_t5_prefill_and_step_match_offline_generate():
+    from tpu_air.models.t5 import (
+        T5Config,
+        T5ForConditionalGeneration,
+        make_t5_decode_step_fn,
+        make_t5_prefill_fn,
+    )
+    from tpu_air.models.t5.generate import generate as t5_generate
+
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    enc = jnp.ones((2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), enc, jnp.ones_like(enc),
+                        jnp.ones((2, 6), jnp.int32))["params"]
+    ids = jnp.array([[4, 5, 6, 1, 0, 0], [9, 8, 7, 6, 5, 1]], jnp.int32)
+    mask = (ids != cfg.pad_token_id).astype(jnp.int32)
+    max_new = 6
+
+    want = np.asarray(t5_generate(model, params, ids, max_new_tokens=max_new,
+                                  early_stop=False))
+
+    prefill = make_t5_prefill_fn(model, max_decode_len=max_new)
+    step = make_t5_decode_step_fn(model)
+    tok, cache, enc_h = prefill(params, ids, mask)
+    got = [np.asarray(tok)]
+    for _ in range(max_new - 1):
+        cache, tok = step(params, cache, tok, enc_h, mask)
+        got.append(np.asarray(tok))
+    got = np.stack(got, axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# serve integration: EngineDeployment + 503 backpressure
+# ---------------------------------------------------------------------------
+
+
+def _post(path, payload, port=PORT):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_engine_deployment_http_and_overload_503(lm, air):
+    from tpu_air import serve
+    from tpu_air.serve import EngineDeployment
+    from tpu_air.train import Checkpoint
+
+    cfg, model, params = lm
+    ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+    try:
+        serve.run(
+            EngineDeployment.options(
+                name="lm-engine", route_prefix="/engine"
+            ).bind(ckpt, EngineConfig(num_slots=2, slot_len=64,
+                                      max_new_tokens=6)),
+            port=PORT,
+        )
+        prompts = _prompts(seed=13, n=3)
+        status, out = _post("/engine", {"prompts": prompts,
+                                        "max_new_tokens": 6})
+        assert status == 200, out
+        assert len(out["results"]) == 3
+        for p, r in zip(prompts, out["results"]):
+            assert r["tokens"] == _offline(model, params, p, 6, None)
+
+        # backpressure: a zero-capacity admission queue rejects EVERY
+        # submit — the replica-side EngineOverloadedError must cross the
+        # actor boundary and surface as HTTP 503 (retry semantics), not 500
+        serve.run(
+            EngineDeployment.options(
+                name="lm-engine-full", route_prefix="/engine-full"
+            ).bind(ckpt, EngineConfig(num_slots=1, slot_len=64,
+                                      max_new_tokens=4, max_queue=0)),
+            port=PORT,
+        )
+        try:
+            status, out = _post("/engine-full", {"prompts": [[1, 2, 3]]})
+        except urllib.error.HTTPError as e:
+            status, out = e.code, json.loads(e.read())
+        assert status == 503, out
+        assert "EngineOverloadedError" in out["error"]
+    finally:
+        serve.shutdown()
+
+
+def test_engine_deployment_streaming_rpc(lm, air):
+    """The submit/poll actor-RPC surface: cursor polling sees the token
+    stream grow and terminate."""
+    import tpu_air
+    from tpu_air import serve
+    from tpu_air.serve import EngineDeployment
+    from tpu_air.train import Checkpoint
+
+    cfg, model, params = lm
+    ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+    try:
+        h = serve.run(
+            EngineDeployment.options(
+                name="lm-engine-stream", route_prefix="/engine-stream"
+            ).bind(ckpt, EngineConfig(num_slots=2, slot_len=64,
+                                      max_new_tokens=8)),
+            port=PORT,
+        )
+        prompt = _prompts(seed=17, n=1)[0]
+        rid = tpu_air.get(h.method("submit")(prompt))
+        toks, cursor = [], 0
+        deadline = time.time() + 120  # replica-side jit compiles on first use
+        while time.time() < deadline:
+            out = tpu_air.get(h.method("poll")(rid, cursor))
+            toks += out["tokens"]
+            cursor = len(toks)
+            if out["done"] and not out["tokens"]:
+                break
+            time.sleep(0.05)
+        assert toks == _offline(model, params, prompt, 8, None)
+        stats = tpu_air.get(h.method("stats")())
+        assert stats["requests_completed"] >= 1
+    finally:
+        serve.shutdown()

@@ -55,3 +55,42 @@ def test_ensure_initialized_noop_without_env():
     from tpu_air.parallel import distributed
 
     assert distributed.ensure_initialized() is False
+
+
+def test_reserve_closest_prefers_whole_free_hosts():
+    """When ANY whole host is free a multi-host span reserves whole hosts
+    only — partial hosts are left for the smaller shape-blocked requests
+    behind it to reserve (the test_lease_stress.py protocol)."""
+    from types import SimpleNamespace
+
+    from tpu_air.core.runtime import Runtime
+
+    # 3 hosts x 4 chips: host0 whole-free, host1 2 free, host2 3 free
+    rt = SimpleNamespace(
+        chips_per_host=4, free_chips=[0, 1, 2, 3, 4, 5, 8, 9, 10]
+    )
+    reserved = set()
+    Runtime._reserve_closest(rt, 8, reserved)  # needs 2 whole hosts
+    assert reserved == {0}  # only the whole host; partials stay nibblable
+
+
+def test_reserve_closest_partial_hosts_no_starvation():
+    """ADVICE r5: with ZERO whole hosts free, a shape-blocked multi-host
+    span must still reserve the hosts closest to recombining — otherwise a
+    stream of single-chip leases keeps nibbling partially-free hosts and
+    the span starves forever."""
+    from types import SimpleNamespace
+
+    from tpu_air.core.runtime import Runtime
+
+    # 4 hosts x 4 chips: free chips/host = [1, 3, 2, 0] — no whole host
+    rt = SimpleNamespace(chips_per_host=4, free_chips=[0, 4, 5, 6, 8, 9])
+    reserved = set()
+    Runtime._reserve_closest(rt, 8, reserved)  # needs 2 whole hosts
+    # the two hosts with the MOST free chips are reserved, so 1-chip
+    # leases can no longer nibble them and they drain toward whole
+    assert reserved == {1, 2}
+    # already-reserved hosts are excluded from the recount
+    reserved2 = {1}
+    Runtime._reserve_closest(rt, 8, reserved2)
+    assert reserved2 == {1, 2, 0}

@@ -20,8 +20,8 @@ Top-level API mirrors the names the reference workloads call::
 
 Subsystem layers live in submodules, imported lazily to keep worker startup
 light: ``tpu_air.data``, ``tpu_air.train``, ``tpu_air.tune``,
-``tpu_air.predict``, ``tpu_air.serve``, ``tpu_air.parallel``,
-``tpu_air.models``.
+``tpu_air.predict``, ``tpu_air.serve``, ``tpu_air.engine``,
+``tpu_air.parallel``, ``tpu_air.models``.
 """
 
 from tpu_air._version import __version__
@@ -49,6 +49,7 @@ _LAZY_SUBMODULES = (
     "tune",
     "predict",
     "serve",
+    "engine",
     "parallel",
     "models",
     "ops",

@@ -717,8 +717,27 @@ class Runtime:
             if h not in reserved:
                 free_by_host[h] = free_by_host.get(h, 0) + 1
         if nchips > cph:
+            need = nchips // cph
             whole = sorted(h for h, f in free_by_host.items() if f == cph)
-            reserved.update(whole[: nchips // cph])
+            if whole:
+                # Some whole hosts are free: reserve only those.  Partial
+                # hosts stay unreserved on purpose — smaller shape-blocked
+                # requests behind this head reserve them for themselves
+                # (see test_lease_stress.py), which transitively protects
+                # the recombination capacity without this head hoarding it.
+                reserved.update(whole[:need])
+            else:
+                # ZERO whole hosts free: reserve the hosts with the MOST
+                # free chips — the ones closest to recombining into whole
+                # hosts — mirroring the single-host branch.  Without this,
+                # a stream of 1-chip leases behind a shape-blocked
+                # multi-host span could keep nibbling partially-free hosts
+                # and no host would ever become whole (ADVICE r5
+                # starvation).
+                partial = sorted(
+                    free_by_host, key=lambda h: (-free_by_host[h], h)
+                )
+                reserved.update(partial[:need])
         elif free_by_host:
             reserved.add(max(free_by_host, key=lambda h: (free_by_host[h], -h)))
 

@@ -1,0 +1,34 @@
+"""tpu_air.engine — continuous-batching online inference.
+
+A fixed pool of sequence slots over flat per-layer KV slabs, one
+persistent compiled decode step, admission/retirement between steps, and
+per-token streaming back to callers.  See docs/SERVING.md for the
+architecture and the token-parity contract with offline ``generate``.
+"""
+
+from .engine import InferenceEngine
+from .metrics import EngineMetrics, snapshot_all
+from .scheduler import Scheduler
+from .slots import Slot, SlotManager, make_insert_fn
+from .types import (
+    EngineClosedError,
+    EngineConfig,
+    EngineOverloadedError,
+    Request,
+    ResponseStream,
+)
+
+__all__ = [
+    "EngineClosedError",
+    "EngineConfig",
+    "EngineMetrics",
+    "EngineOverloadedError",
+    "InferenceEngine",
+    "Request",
+    "ResponseStream",
+    "Scheduler",
+    "Slot",
+    "SlotManager",
+    "make_insert_fn",
+    "snapshot_all",
+]

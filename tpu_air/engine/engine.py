@@ -1,0 +1,268 @@
+"""The continuous-batching inference engine.
+
+One :class:`InferenceEngine` owns a fixed pool of ``S`` sequence slots
+backed by per-layer flat KV slabs ``[S, slot_len, h*d]`` and keeps a single
+persistent jit-compiled decode step alive over that pool for its whole
+lifetime (the cache is donated — slabs update in place, never copied).
+Requests flow through three host-side phases BETWEEN device steps:
+
+1. **admission** — FIFO from the scheduler queue, up to the number of free
+   slots.  Each admitted prompt is right-padded to its length bucket,
+   prefilled (B=1, one compile per bucket), and its KV segment grafted into
+   the free slab row with one jitted ``dynamic_update_slice``.  The first
+   greedy token comes out of prefill itself — TTFT does not wait for the
+   next pool step.
+2. **decode** — one fixed-shape step over all ``S`` rows.  Free rows ride
+   along (pos 0, output discarded host-side); occupied rows each scatter
+   their token's K/V to ``(row, pos[row])`` and attend under a per-row
+   validity mask, so slots at wildly different positions share the step.
+3. **retirement** — a row that emits EOS (inclusive — the EOS id is
+   delivered, matching offline ``generate``) or exhausts its budget is
+   released on the very next host visit; no slab zeroing (stale K/V beyond
+   a new occupant's written positions are masked, then overwritten).
+
+Correctness anchor: with greedy decoding the engine's emitted tokens are
+token-identical to offline ``generate()`` on the same prompts —
+tests/test_engine.py pins this on CPU for burst, staggered and trickle
+arrival schedules.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from tpu_air.models.lm.generate import (
+    init_slot_cache,
+    make_lm_decode_step_fn,
+    make_lm_prefill_fn,
+)
+
+from .metrics import EngineMetrics, unregister
+from .scheduler import Scheduler
+from .slots import Slot, SlotManager, make_insert_fn
+from .types import (
+    EngineClosedError,
+    EngineConfig,
+    Request,
+    ResponseStream,
+)
+
+
+class InferenceEngine:
+    """Slot-pool online inference over a causal LM.
+
+    ``submit`` is thread-safe and non-blocking (raises
+    :class:`EngineOverloadedError` under backpressure); tokens stream back
+    on the returned :class:`ResponseStream` as they are decoded.  With
+    ``auto_start=True`` (the default) a daemon thread drives the step loop;
+    ``auto_start=False`` hands the loop to the caller via :meth:`step` —
+    the deterministic mode the parity tests drive.
+    """
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None,
+                 *, auto_start: bool = True, name: str = "engine"):
+        self.model = model
+        self.params = params
+        self.config = config or EngineConfig()
+        self.name = name
+        cfg = self.config
+        if cfg.eos_token_id == "model":
+            self.eos_token_id = model.config.eos_token_id
+        else:
+            self.eos_token_id = cfg.eos_token_id
+        if cfg.slot_len > model.config.max_seq_len:
+            raise ValueError(
+                f"slot_len {cfg.slot_len} exceeds the model's max_seq_len "
+                f"{model.config.max_seq_len}"
+            )
+
+        # device side: the persistent donated slab pool + compiled phases
+        self.cache = init_slot_cache(model, cfg.num_slots, cfg.slot_len)
+        self._decode_step = make_lm_decode_step_fn(model, cfg.slot_len)
+        self._insert = make_insert_fn()
+        self._prefill_fns: Dict[int, Any] = {}  # bucket -> compiled prefill
+
+        # host side: authoritative per-slot state the step args come from
+        self._cur_tok = np.zeros((cfg.num_slots,), np.int32)
+        self._pos = np.zeros((cfg.num_slots,), np.int32)
+
+        self.scheduler = Scheduler(cfg)
+        self.slots = SlotManager(cfg.num_slots)
+        self.metrics = EngineMetrics(name=name, num_slots=cfg.num_slots)
+
+        self._next_request_id = 0
+        self._id_lock = threading.Lock()
+        self._step_lock = threading.Lock()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if auto_start:
+            self.start()
+
+    # -- submission (any thread) ---------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None) -> ResponseStream:
+        """Queue one prompt; returns its token stream immediately."""
+        if self._closed:
+            raise EngineClosedError("engine is shut down")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        budget = (self.config.max_new_tokens if max_new_tokens is None
+                  else int(max_new_tokens))
+        if budget < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {budget}")
+        if len(prompt) + budget > self.config.slot_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({budget}) "
+                f"exceeds slot_len ({self.config.slot_len})"
+            )
+        with self._id_lock:
+            rid = self._next_request_id
+            self._next_request_id += 1
+        stream = ResponseStream(rid)
+        req = Request(request_id=rid, prompt=prompt, max_new_tokens=budget,
+                      stream=stream)
+        try:
+            self.scheduler.submit(req)
+        except Exception:
+            self.metrics.record_reject()
+            raise
+        self.metrics.record_submit()
+        return stream
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = 120.0) -> List[List[int]]:
+        """Blocking convenience: submit every prompt, join every stream.
+        In manual mode (no background thread) it drives :meth:`step`."""
+        streams = [self.submit(p, max_new_tokens) for p in prompts]
+        if self._thread is None:
+            while not self.idle():
+                self.step()
+        return [s.result(timeout) for s in streams]
+
+    # -- the engine loop -----------------------------------------------------
+    def step(self) -> bool:
+        """One deterministic engine iteration: admit into free slots, then
+        one pool decode step if anything is active.  Returns True if any
+        work happened (callers loop ``while engine.step(): ...`` to drain)."""
+        with self._step_lock:
+            worked = False
+            for req in self.scheduler.pop_admissible(self.slots.free_count()):
+                self._admit(req)
+                worked = True
+            if self.slots.occupancy():
+                self._decode_all()
+                worked = True
+            self.metrics.observe_gauges(
+                self.scheduler.depth(), self.slots.occupancy()
+            )
+            return worked
+
+    def idle(self) -> bool:
+        return self.scheduler.depth() == 0 and self.slots.occupancy() == 0
+
+    def _prefill_for(self, bucket: int):
+        if bucket not in self._prefill_fns:
+            self._prefill_fns[bucket] = make_lm_prefill_fn(self.model, bucket)
+        return self._prefill_fns[bucket]
+
+    def _admit(self, req: Request) -> None:
+        slot = self.slots.acquire()
+        n = len(req.prompt)
+        bucket = self.config.bucket_for(n)
+        ids = np.full((1, bucket), self.model.config.pad_token_id, np.int32)
+        ids[0, :n] = req.prompt
+        tok, segment = self._prefill_for(bucket)(
+            self.params, jnp.asarray(ids), jnp.asarray([n - 1], jnp.int32)
+        )
+        # graft the whole padded segment: pad positions >= n are masked by
+        # the per-row validity check until decode writes overwrite them
+        self.cache = self._insert(self.cache, segment, slot.index)
+        first = int(tok[0])
+        req.first_token_at = time.monotonic()
+        self.metrics.record_ttft(req.first_token_at - req.submitted_at)
+        req.stream._emit(first)
+        self.metrics.record_tokens(1)  # prefill's first token
+        slot.request = req
+        slot.pos = n
+        slot.budget_left = req.max_new_tokens - 1
+        self._cur_tok[slot.index] = first
+        self._pos[slot.index] = n
+        if slot.budget_left == 0 or (
+            self.eos_token_id is not None and first == self.eos_token_id
+        ):
+            self._retire(slot)
+
+    def _decode_all(self) -> None:
+        t0 = time.monotonic()
+        self.cache, nxt = self._decode_step(
+            self.params, self.cache,
+            jnp.asarray(self._cur_tok), jnp.asarray(self._pos),
+        )
+        nxt = np.asarray(nxt)
+        dt = time.monotonic() - t0
+        emitted = 0
+        for slot in self.slots.active_slots():
+            token = int(nxt[slot.index])
+            slot.request.stream._emit(token)
+            emitted += 1
+            slot.pos += 1
+            slot.budget_left -= 1
+            self._cur_tok[slot.index] = token
+            self._pos[slot.index] = slot.pos
+            if slot.budget_left == 0 or (
+                self.eos_token_id is not None and token == self.eos_token_id
+            ):
+                self._retire(slot)
+        self.metrics.record_step(dt, emitted)
+
+    def _retire(self, slot: Slot) -> None:
+        slot.request.stream._finish()
+        self.metrics.record_complete()
+        self.slots.release(slot)
+        self._cur_tok[slot.index] = 0
+        self._pos[slot.index] = 0
+
+    # -- background loop / lifecycle -----------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name=f"tpu-air-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._closed:
+            if not self.step():
+                self.scheduler.wait_for_work(0.01)
+
+    def close(self) -> None:
+        """Stop the loop; fail queued and in-flight requests loudly."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        with self._step_lock:
+            err = EngineClosedError("engine shut down")
+            for req in self.scheduler.drain():
+                req.stream._finish(err)
+            for slot in self.slots.active_slots():
+                slot.request.stream._finish(err)
+                self.slots.release(slot)
+        unregister(self.name)
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

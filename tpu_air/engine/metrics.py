@@ -1,0 +1,178 @@
+"""Engine gauges — queue depth, slot occupancy, tokens/s, TTFT, step
+latency — exported through the existing observability dashboard.
+
+Process-local registry: ``EngineMetrics`` instances self-register by engine
+name at construction; ``observability/dashboard.py`` folds
+:func:`snapshot_all` into ``/metrics`` (prometheus text) and serves it as
+``/api/engines``.  The dashboard runs in the driver process, so it sees the
+engines of THAT process — a driver-embedded engine, or the test/bench
+harness.  Engines inside serve replica workers expose the same snapshot
+over the deployment's ``stats`` method instead (serve/engine_deployment.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict
+
+_WINDOW = 256          # samples kept for the latency distributions
+_RATE_WINDOW_S = 10.0  # tokens/s horizon
+
+
+def _dist(samples) -> Dict[str, float]:
+    xs = sorted(samples)
+    if not xs:
+        return {"count": 0}
+    return {
+        "count": len(xs),
+        "mean": sum(xs) / len(xs),
+        "p50": xs[len(xs) // 2],
+        "p99": xs[min(len(xs) - 1, int(len(xs) * 0.99))],
+        "max": xs[-1],
+    }
+
+
+class EngineMetrics:
+    """Thread-safe gauges/counters for one engine instance."""
+
+    def __init__(self, name: str = "engine", num_slots: int = 0):
+        self.name = name
+        self.num_slots = num_slots
+        self._lock = threading.Lock()
+        # gauges (set whole each observation)
+        self.queue_depth = 0
+        self.slot_occupancy = 0
+        # counters
+        self.requests_submitted = 0
+        self.requests_rejected = 0
+        self.requests_completed = 0
+        self.tokens_emitted = 0
+        # distributions / rates
+        self._ttft_s: Deque[float] = deque(maxlen=_WINDOW)
+        self._step_s: Deque[float] = deque(maxlen=_WINDOW)
+        self._token_stamps: Deque[Any] = deque()  # (t, n) for tokens/s
+        register(self)
+
+    # -- engine-side recording ----------------------------------------------
+    def observe_gauges(self, queue_depth: int, slot_occupancy: int) -> None:
+        with self._lock:
+            self.queue_depth = queue_depth
+            self.slot_occupancy = slot_occupancy
+
+    def record_submit(self) -> None:
+        with self._lock:
+            self.requests_submitted += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.requests_rejected += 1
+
+    def record_complete(self) -> None:
+        with self._lock:
+            self.requests_completed += 1
+
+    def record_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self._ttft_s.append(seconds)
+
+    def record_tokens(self, tokens: int) -> None:
+        """Count emitted tokens outside a pool step (prefill's first token)."""
+        now = time.monotonic()
+        with self._lock:
+            self.tokens_emitted += tokens
+            self._token_stamps.append((now, tokens))
+            self._trim_stamps(now)
+
+    def record_step(self, seconds: float, tokens: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._step_s.append(seconds)
+            self.tokens_emitted += tokens
+            self._token_stamps.append((now, tokens))
+            self._trim_stamps(now)
+
+    def _trim_stamps(self, now: float) -> None:
+        horizon = now - _RATE_WINDOW_S
+        while self._token_stamps and self._token_stamps[0][0] < horizon:
+            self._token_stamps.popleft()
+
+    def reset_window(self) -> None:
+        """Clear the latency windows and rate stamps (counters stay).  For
+        benches that warm jit caches through the engine and then measure a
+        clean steady-state window."""
+        with self._lock:
+            self._ttft_s.clear()
+            self._step_s.clear()
+            self._token_stamps.clear()
+
+    # -- dashboard-side ------------------------------------------------------
+    def tokens_per_s(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            stamps = [(t, n) for t, n in self._token_stamps
+                      if t >= now - _RATE_WINDOW_S]
+            if not stamps:
+                return 0.0
+            span = max(now - stamps[0][0], 1e-6)
+            return sum(n for _, n in stamps) / span
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "name": self.name,
+                "num_slots": self.num_slots,
+                "queue_depth": self.queue_depth,
+                "slot_occupancy": self.slot_occupancy,
+                "requests_submitted": self.requests_submitted,
+                "requests_rejected": self.requests_rejected,
+                "requests_completed": self.requests_completed,
+                "tokens_emitted": self.tokens_emitted,
+                "ttft_s": _dist(self._ttft_s),
+                "step_latency_s": _dist(self._step_s),
+            }
+        out["tokens_per_s"] = self.tokens_per_s()
+        return out
+
+
+_registry: Dict[str, EngineMetrics] = {}
+_registry_lock = threading.Lock()
+
+
+def register(metrics: EngineMetrics) -> None:
+    """Last registration wins per name (an engine restarted under the same
+    name replaces its predecessor's gauges)."""
+    with _registry_lock:
+        _registry[metrics.name] = metrics
+
+
+def unregister(name: str) -> None:
+    with _registry_lock:
+        _registry.pop(name, None)
+
+
+def snapshot_all() -> Dict[str, Dict[str, Any]]:
+    with _registry_lock:
+        engines = list(_registry.values())
+    return {m.name: m.snapshot() for m in engines}
+
+
+def prometheus_lines() -> list:
+    """Engine gauges in prometheus text form (dashboard /metrics)."""
+    lines = []
+    for name, snap in sorted(snapshot_all().items()):
+        tag = f'{{engine="{name}"}}'
+        for key in ("queue_depth", "slot_occupancy", "requests_submitted",
+                    "requests_rejected", "requests_completed",
+                    "tokens_emitted"):
+            lines.append(f"tpu_air_engine_{key}{tag} {snap[key]}")
+        lines.append(f"tpu_air_engine_tokens_per_s{tag} "
+                     f"{snap['tokens_per_s']:.3f}")
+        for dist_key in ("ttft_s", "step_latency_s"):
+            d = snap[dist_key]
+            if d.get("count"):
+                lines.append(
+                    f"tpu_air_engine_{dist_key}_p50{tag} {d['p50']:.6f}"
+                )
+    return lines

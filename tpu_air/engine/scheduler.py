@@ -1,0 +1,68 @@
+"""Admission scheduler: FIFO queue with backpressure + bucket grouping.
+
+Policy (docs/SERVING.md §scheduling): requests are admitted strictly in
+arrival order — never reordered for bucket affinity — up to the number of
+free slots each engine step.  FIFO keeps the scheduler DETERMINISTIC for a
+given arrival schedule, which is what the engine's token-parity gate tests
+against; bucket grouping is only an ordering hint WITHIN one admission
+round so same-bucket prefills sit adjacent (shared compiled program,
+warm icache), not a reordering across rounds.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, List
+
+from .types import EngineConfig, EngineOverloadedError, Request
+
+
+class Scheduler:
+    """Thread-safe FIFO admission queue over :class:`EngineConfig` dials."""
+
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        self._queue: Deque[Request] = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+
+    # -- producer side (any thread) ------------------------------------------
+    def submit(self, request: Request) -> None:
+        """Enqueue; raises :class:`EngineOverloadedError` when the queue is
+        at ``max_queue`` (backpressure — the caller sees 503, retries)."""
+        with self._lock:
+            if len(self._queue) >= self.config.max_queue:
+                raise EngineOverloadedError(
+                    f"engine admission queue full "
+                    f"({len(self._queue)}/{self.config.max_queue})"
+                )
+            self._queue.append(request)
+            self._work.set()
+
+    # -- engine-loop side ----------------------------------------------------
+    def pop_admissible(self, free_slots: int) -> List[Request]:
+        """Dequeue up to ``free_slots`` requests in FIFO order."""
+        out: List[Request] = []
+        with self._lock:
+            while self._queue and len(out) < free_slots:
+                out.append(self._queue.popleft())
+            if not self._queue:
+                self._work.clear()
+        return out
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def drain(self) -> List[Request]:
+        """Remove and return every queued request (engine shutdown)."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+            self._work.clear()
+        return out
+
+    def wait_for_work(self, timeout: float) -> bool:
+        """Block until something is queued (or timeout); engine idle-wait."""
+        return self._work.wait(timeout)

@@ -1,0 +1,101 @@
+"""Slot table + KV-slab insertion for the continuous-batching engine.
+
+A *slot* is one row of the engine's fixed decode batch: row ``s`` of every
+per-layer flat KV slab ``[S, L_slot, h*d]``.  The host-side
+:class:`SlotManager` tracks which request occupies each row and where its
+context ends; the device side is one jitted ``dynamic_update_slice`` per
+admission that grafts a prefilled cache segment into the free row.
+
+Lifecycle of a slot (docs/SERVING.md §slab lifecycle)::
+
+    free -> [admit] occupied(pos=len(prompt)) -> [decode steps] pos+1 each
+         -> [EOS or budget] free again -- no slab zeroing on retirement:
+    stale K/V beyond the next occupant's written positions are masked by
+    the per-row validity mask (arange <= index[row]) and progressively
+    overwritten, so retirement costs exactly one host-side list append.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from .types import Request
+
+
+@dataclass
+class Slot:
+    """Host bookkeeping for one slab row."""
+
+    index: int
+    request: Optional[Request] = None
+    pos: int = 0            # cache write position == tokens in context
+    budget_left: int = 0    # decode steps remaining before forced retirement
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+
+class SlotManager:
+    """Free-list over the ``S`` slab rows."""
+
+    def __init__(self, num_slots: int):
+        self.slots: List[Slot] = [Slot(i) for i in range(num_slots)]
+        # pop() takes from the end: keep it ascending-last so admission
+        # fills row 0 first (deterministic slot assignment for the parity
+        # tests — FIFO arrival k lands in the lowest free row)
+        self._free: List[int] = list(range(num_slots))[::-1]
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def active_slots(self) -> List[Slot]:
+        return [s for s in self.slots if s.active]
+
+    def occupancy(self) -> int:
+        return len(self.slots) - len(self._free)
+
+    def acquire(self) -> Slot:
+        slot = self.slots[self._free.pop()]
+        assert not slot.active, "acquired an occupied slot"
+        return slot
+
+    def release(self, slot: Slot) -> None:
+        slot.request = None
+        slot.pos = 0
+        slot.budget_left = 0
+        # keep the free list sorted descending so the next acquire still
+        # hands out the lowest free row
+        self._free.append(slot.index)
+        self._free.sort(reverse=True)
+
+
+def make_insert_fn():
+    """Jitted segment insertion: graft a prefilled cache segment (per-layer
+    ``[1, Lb, h*d]`` slabs) into slab row ``slot`` of the engine cache.
+    The engine cache is donated — insertion updates the pool in place.
+    ``cache_index`` leaves pass through: the decode step overwrites them
+    from the host-authoritative ``pos`` vector every call."""
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def insert(cache: Dict[str, Any], segment: Dict[str, Any], slot):
+        def walk(c, s):
+            out = {}
+            for k, v in c.items():
+                if isinstance(v, dict):
+                    out[k] = walk(v, s[k])
+                elif k == "cache_index":
+                    out[k] = v
+                else:
+                    out[k] = jax.lax.dynamic_update_slice(
+                        v, s[k].astype(v.dtype), (slot, 0, 0)
+                    )
+            return out
+
+        return walk(cache, segment)
+
+    return insert

@@ -1,0 +1,145 @@
+"""Request/response types and config for the online inference engine.
+
+The engine's unit of work is a :class:`Request` (one prompt + decode
+budget); its unit of delivery is a :class:`ResponseStream` — emitted token
+ids land on the stream the same engine step they are decoded, so callers
+see time-to-first-token, not time-to-last-token.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
+
+from tpu_air.core.runtime import TpuAirError
+
+
+class EngineOverloadedError(TpuAirError):
+    """Admission queue is full — backpressure, not failure.  The serve
+    proxy maps this to HTTP 503 (the NoLiveReplicasError semantics): the
+    client should retry, nothing is broken."""
+
+
+class EngineClosedError(TpuAirError):
+    """The engine was shut down with this request still queued/in flight."""
+
+
+@dataclass
+class EngineConfig:
+    """Dials for the slot pool and admission policy.
+
+    * ``num_slots`` — S, the fixed decode batch width.  One persistent
+      compiled step serves the whole engine lifetime; a slot is one
+      in-flight sequence.
+    * ``slot_len`` — L, positions per slot (the flat KV slab is
+      ``[S, L, h*d]`` per layer).  Admission requires
+      ``len(prompt) + max_new_tokens <= slot_len``.
+    * ``max_new_tokens`` — default per-request decode budget.
+    * ``max_queue`` — queued (not yet admitted) request cap; beyond it
+      ``submit`` raises :class:`EngineOverloadedError`.
+    * ``prefill_buckets`` — prompt-length buckets (ascending).  Prompts are
+      right-padded to the smallest fitting bucket so prefill compiles once
+      per bucket, not once per length.  ``None`` → powers of two up to
+      ``slot_len``.
+    * ``eos_token_id`` — ``"model"`` (default): use the model config's
+      ``eos_token_id``; ``None``: never early-stop (budget-only
+      retirement); an int: that id.
+    """
+
+    num_slots: int = 8
+    slot_len: int = 256
+    max_new_tokens: int = 64
+    max_queue: int = 256
+    prefill_buckets: Optional[Tuple[int, ...]] = None
+    eos_token_id: Union[int, None, str] = "model"
+
+    def buckets(self) -> Tuple[int, ...]:
+        if self.prefill_buckets is not None:
+            return tuple(sorted(self.prefill_buckets))
+        out, b = [], 1
+        while b < self.slot_len:
+            out.append(b)
+            b *= 2
+        out.append(self.slot_len)
+        return tuple(out)
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets():
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt length {n} exceeds the largest prefill bucket "
+            f"{self.buckets()[-1]} (slot_len={self.slot_len})"
+        )
+
+
+_DONE = object()
+
+
+class ResponseStream:
+    """Per-request token stream.
+
+    The engine appends ids as they are decoded; callers either iterate
+    (``for tok in stream: ...`` — blocks until each token arrives, ends at
+    retirement) or join (``stream.result()`` — the full id list, raising if
+    the request failed).  Emitted tokens INCLUDE the EOS id when early-stop
+    triggered, matching offline ``generate`` (which emits EOS then pads).
+    """
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._q: "queue.Queue" = queue.Queue()
+        self._tokens: List[int] = []
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    # -- engine side ---------------------------------------------------------
+    def _emit(self, token: int) -> None:
+        self._tokens.append(token)
+        self._q.put(token)
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        self._error = error
+        self._done.set()
+        self._q.put(_DONE)
+
+    # -- caller side ---------------------------------------------------------
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            item = self._q.get()
+            if item is _DONE:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished after {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return list(self._tokens)
+
+    def tokens_so_far(self) -> List[int]:
+        return list(self._tokens)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+@dataclass
+class Request:
+    """One admitted unit of work (internal; callers hold the stream)."""
+
+    request_id: int
+    prompt: Sequence[int]
+    max_new_tokens: int
+    stream: ResponseStream
+    submitted_at: float = field(default_factory=time.monotonic)
+    first_token_at: Optional[float] = None

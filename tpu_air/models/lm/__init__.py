@@ -1,5 +1,11 @@
 from .config import LMConfig
-from .generate import generate, make_lm_generate_fn
+from .generate import (
+    generate,
+    init_slot_cache,
+    make_lm_decode_step_fn,
+    make_lm_generate_fn,
+    make_lm_prefill_fn,
+)
 from .modeling import (
     CausalLM,
     head_weight,
@@ -11,7 +17,10 @@ from .modeling import (
 __all__ = [
     "LMConfig",
     "generate",
+    "init_slot_cache",
+    "make_lm_decode_step_fn",
     "make_lm_generate_fn",
+    "make_lm_prefill_fn",
     "CausalLM",
     "head_weight",
     "lm_chunked_loss_with_targets",

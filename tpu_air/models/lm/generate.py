@@ -48,10 +48,18 @@ def make_lm_generate_fn(model: CausalLM, max_new_tokens: int,
                         do_sample: bool = False, temperature: float = 1.0,
                         top_k: int = 0, eos_token_id: Optional[int] = None,
                         early_stop: bool = True):
-    """Build a jitted ``fn(params, input_ids, rng) -> (B, max_new_tokens)``.
+    """Build a jitted ``fn(params, input_ids, rng, live_mask=None) ->
+    (B, max_new_tokens)``.
 
     ``input_ids``: (B, L_prompt) un-padded prompts (fixed shape per compile).
     After ``eos_token_id`` is emitted a row keeps emitting pad.
+
+    ``live_mask``: optional (B,) bool — True marks a REAL row, False marks
+    bucket filler the batching wrapper appended (born finished: emits pure
+    pad, never holds early-stop open).  Filler is declared by the caller —
+    the host side knows which rows it appended — never inferred from
+    content, so an all-pad USER prompt generates normally (ADVICE r5).
+    ``None`` means every row is real.
 
     ``early_stop=True`` (requires ``eos_token_id``; the t5/generate.py
     pattern) runs the decode as a ``lax.while_loop`` that exits once EVERY
@@ -65,7 +73,7 @@ def make_lm_generate_fn(model: CausalLM, max_new_tokens: int,
         return sample_token(logits, rng, do_sample, temperature, top_k)
 
     @jax.jit
-    def generate(params, input_ids, rng):
+    def generate(params, input_ids, rng, live_mask=None):
         b, lp = input_ids.shape
         total = lp + max_new_tokens
         if total > cfg.max_seq_len:
@@ -90,9 +98,11 @@ def make_lm_generate_fn(model: CausalLM, max_new_tokens: int,
         rng, sub = jax.random.split(rng)
         tok = pick(hidden[:, -1].astype(jnp.float32) @ head_w, sub)
         if eos_token_id is not None:
-            # an all-pad row is bucket filler: born finished, it emits pure
-            # pad and never holds the while_loop open for the full budget
-            filler = jnp.all(input_ids == pad, axis=-1)
+            # filler rows (declared by the caller's live_mask) are born
+            # finished: they emit pure pad and never hold the while_loop
+            # open for the full budget
+            filler = (jnp.zeros((b,), bool) if live_mask is None
+                      else ~live_mask)
             tok = jnp.where(filler, pad, tok)
             done = filler | (tok == eos_token_id)
         else:
@@ -152,6 +162,117 @@ def make_lm_generate_fn(model: CausalLM, max_new_tokens: int,
     return generate
 
 
+# ---------------------------------------------------------------------------
+# Continuous-batching entry points (tpu_air.engine)
+#
+# make_lm_generate_fn keeps prefill and the per-token step private inside one
+# jitted program — right for offline batches, useless for an engine that must
+# admit/retire requests BETWEEN steps.  These expose the same two phases as
+# standalone compiled units over the engine's slot-pool cache layout:
+# per-layer flat slabs [S, L_slot, h*d] plus a PER-ROW cache index (each slot
+# sits at its own position — modeling.py scatters the new token's K/V to
+# (row, index[row]) and masks per row).
+# ---------------------------------------------------------------------------
+
+
+def _map_cache_index(cache, fn):
+    """Rebuild a flax cache dict with ``fn`` applied to every cache_index
+    leaf (slabs pass through untouched)."""
+    out = {}
+    for k, v in cache.items():
+        if isinstance(v, dict):
+            out[k] = _map_cache_index(v, fn)
+        elif k == "cache_index":
+            out[k] = fn(v)
+        else:
+            out[k] = v
+    return out
+
+
+def init_slot_cache(model: CausalLM, num_slots: int, slot_len: int):
+    """Zero KV slab pool for ``num_slots`` sequence slots of ``slot_len``
+    positions each, with PER-SLOT cache indices ([S] int32 vector instead of
+    the offline scalar).  This is the persistent cache the engine's decode
+    step carries (and donates) across its whole lifetime."""
+    dmodel = CausalLM(LMConfig.from_dict(
+        {**model.config.to_dict(), "max_seq_len": slot_len}
+    ))
+    cache = init_cache(dmodel, num_slots)
+    return _map_cache_index(
+        cache, lambda _: jnp.zeros((num_slots,), jnp.int32)
+    )
+
+
+def make_lm_prefill_fn(model: CausalLM, prompt_len: int):
+    """Build a jitted ``fn(params, input_ids, last_index) -> (tok, cache)``:
+    one whole-prompt cached pass producing the first greedy token plus the
+    prompt's KV segment (per-layer ``[B, prompt_len, h*d]`` slabs) ready for
+    ``dynamic_update_slice`` insertion into a free engine slot.
+
+    ``input_ids``: (B, prompt_len) prompts right-padded to the length bucket;
+    ``last_index``: (B,) index of each row's LAST REAL token (the head is
+    applied there, not at the padded end — right-padding can't leak into
+    earlier positions under the causal mask, so bucketed prefill is
+    token-identical to an exact-length prefill)."""
+    cfg = model.config
+
+    @jax.jit
+    def prefill(params, input_ids, last_index):
+        b, lp = input_ids.shape
+        dmodel = CausalLM(LMConfig.from_dict(
+            {**cfg.to_dict(), "max_seq_len": lp}
+        ))
+        cache = init_cache(dmodel, b)
+        positions = jnp.broadcast_to(jnp.arange(lp, dtype=jnp.int32), (b, lp))
+        hidden, vars_ = dmodel.apply(
+            {"params": params, "cache": cache}, input_ids, positions,
+            decode=True, return_hidden=True, mutable=["cache"],
+        )
+        head_w = head_weight(params, cfg).astype(jnp.float32)
+        h_last = jnp.take_along_axis(
+            hidden, last_index[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        tok = jnp.argmax(
+            h_last.astype(jnp.float32) @ head_w, axis=-1
+        ).astype(jnp.int32)
+        return tok, vars_["cache"]
+
+    return prefill
+
+
+def make_lm_decode_step_fn(model: CausalLM, slot_len: int):
+    """Build THE persistent engine step: a jitted ``fn(params, cache, tok,
+    pos) -> (cache', next_tok)`` over the fixed slot pool, cache donated so
+    the slabs update in place across the engine's lifetime.
+
+    ``tok``/``pos``: (S,) current token and cache position per slot.  Every
+    slot steps every call (fixed shape — the continuous-batching discipline);
+    free slots ride along at pos 0 and their outputs are discarded host-side.
+    Greedy by construction: the engine's correctness anchor is token-equality
+    with offline greedy ``generate``."""
+    cfg = model.config
+    dcfg = {**cfg.to_dict(), "max_seq_len": slot_len}
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def step(params, cache, tok, pos):
+        dmodel = CausalLM(LMConfig.from_dict(dcfg))
+        pos = pos.astype(jnp.int32)
+        cache = _map_cache_index(cache, lambda _: pos)
+        hidden, vars_ = dmodel.apply(
+            {"params": params, "cache": cache}, tok[:, None], pos[:, None],
+            decode=True, return_hidden=True, mutable=["cache"],
+        )
+        head_w = head_weight(params, cfg).astype(jnp.float32)
+        nxt = jnp.argmax(
+            hidden[:, -1].astype(jnp.float32) @ head_w, axis=-1
+        ).astype(jnp.int32)
+        return vars_["cache"], nxt
+
+    return step
+
+
 _GEN_CACHE: Dict[Tuple, Any] = {}
 _GEN_CACHE_MAX = 16
 
@@ -186,9 +307,16 @@ def generate(model: CausalLM, params, input_ids, max_new_tokens: int = 64,
     # bucketing win is then compile-cache reuse only.
     n = ids.shape[0]
     bucket = 1 << max(0, int(n - 1).bit_length())
+    live_mask = None
     if bucket != n:
         ids = jnp.concatenate(
             [ids, jnp.full((bucket - n, ids.shape[1]),
                            model.config.pad_token_id, jnp.int32)]
         )
-    return _GEN_CACHE[key](params, ids, rng)[:n]
+        # declare the appended rows as filler EXPLICITLY (this wrapper knows
+        # which rows it added) instead of inferring filler from all-pad
+        # content — a legitimate all-pad user prompt stays live (ADVICE r5)
+        live_mask = jnp.concatenate(
+            [jnp.ones((n,), bool), jnp.zeros((bucket - n,), bool)]
+        )
+    return _GEN_CACHE[key](params, ids, rng, live_mask)[:n]

@@ -1,7 +1,12 @@
 """Flax T5 / FLAN-T5 model family."""
 
 from .config import T5Config
-from .generate import generate, make_generate_fn
+from .generate import (
+    generate,
+    make_generate_fn,
+    make_t5_decode_step_fn,
+    make_t5_prefill_fn,
+)
 from .hf_import import config_from_hf, convert_t5_state_dict, load_t5_from_hf
 from .modeling import (
     T5ForConditionalGeneration,
@@ -18,5 +23,7 @@ __all__ = [
     "generate",
     "load_t5_from_hf",
     "make_generate_fn",
+    "make_t5_decode_step_fn",
+    "make_t5_prefill_fn",
     "shift_right",
 ]

@@ -162,6 +162,67 @@ def make_generate_fn(
     return generate_fn
 
 
+# ---------------------------------------------------------------------------
+# Continuous-batching entry points (tpu_air.engine)
+#
+# make_generate_fn keeps the encode+cache-build prefill and the per-token
+# decode private inside one jitted program.  These expose the two phases as
+# standalone compiled units so an online engine can admit/retire between
+# steps.  Encoder-decoder caveat: the decode cache carries the CROSS-
+# attention K/V of the whole batch's encoder output, so these entry points
+# are batch-synchronized (one scalar cache index — every row at the same
+# decode position); per-slot cross-attn slabs are the remaining work before
+# the slot engine (engine/engine.py) can drive the T5 family.
+# ---------------------------------------------------------------------------
+
+
+def make_t5_prefill_fn(model: T5ForConditionalGeneration,
+                       max_decode_len: int):
+    """Build a jitted ``fn(params, input_ids, attention_mask) ->
+    (first_tok, cache, enc_hidden)``: encode the prompts, build the decode
+    cache (self-attn slabs zeroed, cross-attn K/V computed from the encoder
+    output — the prefill-into-segment), and run the first decode step from
+    ``decoder_start_token_id``, returning the first greedy token."""
+    cfg: T5Config = model.config
+
+    @jax.jit
+    def prefill(params, input_ids, attention_mask):
+        batch = input_ids.shape[0]
+        enc = model.apply(
+            {"params": params}, input_ids, attention_mask, method=model.encode
+        )
+        cache = init_cache(model, params, batch, max_decode_len, enc,
+                           attention_mask)
+        tok0 = jnp.full((batch, 1), cfg.decoder_start_token_id, jnp.int32)
+        logits, vars_ = model.apply(
+            {"params": params, "cache": cache}, tok0, enc, attention_mask,
+            decode=True, mutable=["cache"], method=model.decode,
+        )
+        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return tok, vars_["cache"], enc
+
+    return prefill
+
+
+def make_t5_decode_step_fn(model: T5ForConditionalGeneration):
+    """Build a jitted single-token decode step ``fn(params, cache, tok,
+    enc_hidden, enc_mask) -> (cache', next_tok)`` with the cache donated —
+    the per-step unit an online loop re-invokes, greedy (the engine parity
+    anchor)."""
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def step(params, cache, tok, enc_hidden, enc_mask):
+        logits, vars_ = model.apply(
+            {"params": params, "cache": cache}, tok[:, None], enc_hidden,
+            enc_mask, decode=True, mutable=["cache"], method=model.decode,
+        )
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return vars_["cache"], nxt
+
+    return step
+
+
 _GEN_CACHE: Dict[Tuple, Any] = {}
 _GEN_CACHE_MAX = 16
 

@@ -316,6 +316,16 @@ class Attention(nn.Module):
                 and impl_eff != "einsum"
             )
             if fast_ok:
+                if mask is not None and not (
+                    mask.ndim == 4 and mask.shape[2] == 1
+                ):
+                    # the comb[0, :, 0, :] slice below assumes the decode
+                    # mask layout [1, h|1, 1, klen]; any other layout would
+                    # be silently mis-sliced (ADVICE r5) — fail loudly
+                    raise ValueError(
+                        "decode fast path expects a [1, h|1, 1, klen] "
+                        f"mask; got shape {mask.shape}"
+                    )
                 bias_arg = None
                 if position_bias is not None or mask is not None:
                     comb = jnp.zeros((1, 1, 1, klen), jnp.float32)

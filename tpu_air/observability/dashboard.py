@@ -5,8 +5,9 @@ Install_locally.md:64-67):
   /                 tiny HTML overview
   /api/cluster      resources, workers, actors, queue depth
   /api/objects      object-store + arena stats
+  /api/engines      per-engine gauges (queue depth, occupancy, tokens/s, TTFT)
   /api/version      framework version
-  /metrics          prometheus text exposition of the cluster gauges
+  /metrics          prometheus text exposition of the cluster + engine gauges
 """
 
 from __future__ import annotations
@@ -89,6 +90,17 @@ def object_stats() -> Dict[str, Any]:
     return out
 
 
+def engine_stats() -> Dict[str, Any]:
+    """Per-engine gauge snapshots (the /api/engines payload).  Engines in
+    THIS process only — a driver-embedded engine or the bench/test harness;
+    serve replica engines report through the deployment's ``stats`` method."""
+    try:
+        from tpu_air.engine.metrics import snapshot_all
+    except Exception:  # noqa: BLE001 — engine package optional (no jax)
+        return {}
+    return snapshot_all()
+
+
 def _prometheus_text() -> str:
     snap = snapshot()
     lines = []
@@ -108,6 +120,15 @@ def _prometheus_text() -> str:
         if "arena" in ost:
             for k, v in ost["arena"].items():
                 lines.append(f"tpu_air_arena_{k} {v}")
+    # engine gauges live OUTSIDE the initialized check: an engine embedded
+    # in this process (tests, bench, notebook) exports metrics even when the
+    # cluster runtime was never brought up
+    try:
+        from tpu_air.engine.metrics import prometheus_lines
+    except Exception:  # noqa: BLE001 — engine package optional (no jax)
+        pass
+    else:
+        lines += prometheus_lines()
     return "\n".join(lines) + "\n"
 
 
@@ -115,6 +136,7 @@ _INDEX_HTML = """<!doctype html><html><head><title>tpu_air dashboard</title></he
 <body><h2>tpu_air dashboard</h2>
 <p>JSON endpoints: <a href="/api/cluster">/api/cluster</a> ·
 <a href="/api/objects">/api/objects</a> ·
+<a href="/api/engines">/api/engines</a> ·
 <a href="/api/version">/api/version</a> ·
 <a href="/metrics">/metrics</a></p>
 <pre id="s"></pre>
@@ -147,6 +169,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(snapshot()).encode(), "application/json")
             elif path == "/api/objects":
                 self._send(200, json.dumps(object_stats()).encode(), "application/json")
+            elif path == "/api/engines":
+                self._send(200, json.dumps(engine_stats()).encode(), "application/json")
             elif path == "/api/version":
                 import tpu_air
 

@@ -33,9 +33,13 @@ Masking contract: ``bias`` is an additive f32 ``[h, L]`` that already
 includes any causal/validity masking (the T5 decode path's relative-
 position bias + causal row collapse to exactly this); ``kv_mask`` is the
 per-batch key-padding mask.  A fully-masked ROW (no valid key at all)
-yields a zero context vector — decode rows always have >=1 valid key
-(self: position 0; cross: a non-empty prompt), so this is a don't-care
-guarded against NaN.
+does NOT yield a zero context vector: every score sits at the same mask
+floor, the softmax degenerates to UNIFORM, and the output is the plain
+mean of V over all (masked) positions — finite, NaN-free, but carrying
+no information.  Decode rows always have >=1 valid key (self: position
+0; cross: a non-empty prompt), so this is a don't-care guarded against
+NaN; callers that could produce an all-masked row must treat its output
+as undefined rather than zero (ADVICE r5).
 
 f32 score/softmax math, MXU-dtype (bf16 on chip) operands — the same
 precision budget as the dense path it replaces.

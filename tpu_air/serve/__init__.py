@@ -19,6 +19,7 @@ from .deployment import (
     NoLiveReplicasError,
     deployment,
 )
+from .engine_deployment import EngineDeployment
 from .http_adapters import json_request, pandas_read_json
 from .predictor_deployment import PredictorDeployment
 from .proxy import run, shutdown, status
@@ -27,6 +28,7 @@ __all__ = [
     "Application",
     "Deployment",
     "DeploymentHandle",
+    "EngineDeployment",
     "NoLiveReplicasError",
     "PredictorDeployment",
     "deployment",

@@ -1,0 +1,123 @@
+"""EngineDeployment — serve the continuous-batching engine over HTTP.
+
+Each replica actor owns one :class:`tpu_air.engine.InferenceEngine` (slot
+pool + persistent decode step + background loop) built from a Checkpoint.
+Two client surfaces:
+
+* blocking HTTP: ``POST {"prompts": [[ids...], ...], "max_new_tokens": n}``
+  → ``{"results": [{"request_id": ..., "tokens": [...]}, ...]}`` — every
+  prompt is submitted up front so they share slot-pool steps, then joined.
+* streaming over actor RPC: ``handle.method("submit")(prompt)`` →
+  request id, then ``handle.method("poll")(rid, cursor)`` →
+  ``{"tokens": <new since cursor>, "done": bool}`` — polling cursor
+  streaming, the shape HTTP long-poll clients want (the proxy itself is
+  plain request/response).
+
+Backpressure: a full admission queue raises
+:class:`~tpu_air.engine.EngineOverloadedError` inside the replica; it
+crosses the actor boundary as ``RemoteError`` and the proxy maps it to
+HTTP 503 (same retry semantics as ``NoLiveReplicasError``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .deployment import Deployment
+
+
+class _EngineServer:
+    """The engine itself is built LAZILY on the first request, not in
+    ``__init__``: the core runtime round-trips a replica instance through
+    the (pickle-based) object store at actor creation, and a live engine
+    holds threads, locks and device buffers — unpicklable by design.  The
+    constructor keeps only the picklable recipe (checkpoint + config)."""
+
+    def __init__(
+        self,
+        checkpoint,
+        engine_config=None,
+        *,
+        dtype: Optional[str] = None,
+        engine_name: str = "engine",
+        join_timeout: float = 300.0,
+    ):
+        self._checkpoint = checkpoint
+        self._engine_config = engine_config
+        self._dtype = dtype
+        self._engine_name = engine_name
+        self._join_timeout = join_timeout
+        self._engine = None
+        self._streams: Dict[int, Any] = {}
+
+    def _ensure_engine(self):
+        if self._engine is None:
+            # lazy import: the serve package must stay importable without jax
+            from tpu_air.engine import EngineConfig, InferenceEngine
+
+            model, params = self._checkpoint.get_model(dtype=self._dtype)
+            if self._dtype:
+                import jax
+                import jax.numpy as jnp
+
+                params = jax.tree_util.tree_map(
+                    lambda x: (x.astype(jnp.dtype(self._dtype))
+                               if hasattr(x, "astype") else x),
+                    params,
+                )
+            self._engine = InferenceEngine(
+                model, params, self._engine_config or EngineConfig(),
+                name=self._engine_name,
+            )
+        return self._engine
+
+    # -- blocking HTTP path ---------------------------------------------------
+    def __call__(self, payload) -> Dict[str, Any]:
+        if not isinstance(payload, dict):
+            raise ValueError(
+                'expected JSON object {"prompts": [[ids...], ...]} '
+                '(or {"prompt": [ids...]})'
+            )
+        if "prompt" in payload:
+            prompts = [payload["prompt"]]
+        else:
+            prompts = payload.get("prompts")
+        if not prompts:
+            raise ValueError('payload needs "prompt" or a non-empty "prompts"')
+        max_new = payload.get("max_new_tokens")
+        engine = self._ensure_engine()
+        # submit ALL before joining ANY — concurrent prompts share pool steps
+        streams = [engine.submit(p, max_new) for p in prompts]
+        return {
+            "results": [
+                {"request_id": s.request_id,
+                 "tokens": s.result(self._join_timeout)}
+                for s in streams
+            ]
+        }
+
+    # -- streaming path (actor RPC) -------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None) -> int:
+        stream = self._ensure_engine().submit(prompt, max_new_tokens)
+        self._streams[stream.request_id] = stream
+        return stream.request_id
+
+    def poll(self, request_id: int, cursor: int = 0) -> Dict[str, Any]:
+        stream = self._streams.get(request_id)
+        if stream is None:
+            raise KeyError(f"unknown request_id {request_id}")
+        toks = stream.tokens_so_far()
+        done = stream.done
+        if done and len(toks) <= cursor:
+            self._streams.pop(request_id, None)  # fully drained
+        return {"tokens": toks[cursor:], "done": done}
+
+    def stats(self) -> Dict[str, Any]:
+        return self._ensure_engine().metrics.snapshot()
+
+
+EngineDeployment = Deployment(
+    func_or_class=_EngineServer,
+    name="EngineDeployment",
+    num_replicas=1,
+)

@@ -14,6 +14,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from tpu_air.core import api as core_api
+from tpu_air.core.runtime import RemoteError
 
 from .deployment import (
     Application,
@@ -114,6 +115,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200, _to_jsonable(result))
         except NoLiveReplicasError as e:
             self._respond(503, {"error": str(e)})
+        except RemoteError as e:
+            # replica-side backpressure (engine admission queue full) is the
+            # same "retry later, nothing is broken" contract as zero live
+            # replicas — 503, not 500
+            if e.cause_repr.startswith("EngineOverloadedError"):
+                self._respond(503, {"error": e.cause_repr})
+            else:
+                self._respond(500, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001 — surface the error to the client
             self._respond(500, {"error": f"{type(e).__name__}: {e}"})
 
