@@ -1,0 +1,579 @@
+"""Tier-1 tests for airlint (tpu_air.analysis).
+
+Pure-stdlib: tpu_air.analysis never imports jax, so this whole module runs
+in well under 10s.  Three layers:
+
+1. per-rule fixtures — one snippet that violates the rule (asserting the
+   exact rule id and line) plus one clean twin that must stay quiet;
+2. suppression parsing — reasoned suppressions silence, reason-less ones
+   are inert AND are themselves a finding (AL001);
+3. self-application — airlint over the repo's own ``tpu_air/`` tree must
+   report zero unsuppressed findings, and the CLI must gate on that.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpu_air import analysis
+from tpu_air.analysis import Severity, all_rules, analyze_paths, analyze_source
+from tpu_air.analysis.cli import main as cli_main
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def check(src, only=None):
+    return analyze_source(textwrap.dedent(src), path="fix.py", only=only)
+
+
+def line_of(src, needle):
+    """1-based line of the first dedented source line containing needle."""
+    for i, ln in enumerate(textwrap.dedent(src).splitlines(), start=1):
+        if needle in ln:
+            return i
+    raise AssertionError(f"fixture is missing marker {needle!r}")
+
+
+def assert_fires(src, rule_id, needle, only=None):
+    rep = check(src, only=only)
+    hits = [f for f in rep.active if f.rule == rule_id]
+    assert hits, f"{rule_id} did not fire; got {[f.rule for f in rep.active]}"
+    assert hits[0].path == "fix.py"
+    assert hits[0].line == line_of(src, needle)
+    return hits[0]
+
+
+def assert_quiet(src, rule_id, only=None):
+    rep = check(src, only=only)
+    hits = [f for f in rep.findings if f.rule == rule_id]
+    assert not hits, f"{rule_id} fired on the clean twin: {hits[0].message}"
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: one violation + one clean twin each
+# ---------------------------------------------------------------------------
+
+
+class TestJX001TracerLeak:
+    VIOLATION = """\
+        import jax
+
+        class Model:
+            @jax.jit
+            def step(self, x):
+                self.state = x * 2
+                return x
+        """
+
+    CLEAN = """\
+        import jax
+
+        class Model:
+            @jax.jit
+            def step(self, x):
+                new_state = x * 2
+                return new_state
+
+            def commit(self, new_state):
+                self.state = new_state
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "JX001", "self.state = x * 2")
+        assert f.severity == Severity.ERROR
+        assert "self.state" in f.message
+
+    def test_clean_twin(self):
+        assert_quiet(self.CLEAN, "JX001")
+
+    def test_global_write(self):
+        src = """\
+            import jax
+
+            CACHE = None
+
+            @jax.jit
+            def step(x):
+                global CACHE
+                CACHE = x + 1
+                return x
+            """
+        assert_fires(src, "JX001", "CACHE = x + 1")
+
+
+class TestJX002UseAfterDonate:
+    VIOLATION = """\
+        import jax
+
+        def _step(params):
+            return params
+
+        train = jax.jit(_step, donate_argnums=(0,))
+
+        def run(params):
+            out = train(params)
+            grads = params
+            return out, grads
+        """
+
+    CLEAN = """\
+        import jax
+
+        def _step(params):
+            return params
+
+        train = jax.jit(_step, donate_argnums=(0,))
+
+        def run(params):
+            params = train(params)
+            return params
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "JX002", "grads = params")
+        assert f.severity == Severity.ERROR
+        assert "donated" in f.message
+
+    def test_clean_twin(self):
+        assert_quiet(self.CLEAN, "JX002")
+
+    def test_loop_wraparound(self):
+        # donated but never rebound: next iteration reads the dead buffer
+        src = """\
+            import jax
+
+            def _step(params, batch):
+                return None
+
+            train = jax.jit(_step, donate_argnums=(0,))
+
+            def run(params, batches):
+                for batch in batches:
+                    loss = train(params, batch)
+            """
+        assert_fires(src, "JX002", "loss = train(params, batch)")
+
+
+class TestJX003RecompileHazard:
+    VIOLATION = """\
+        import jax
+
+        def run(fns, x):
+            for fn in fns:
+                g = jax.jit(fn)
+                x = g(x)
+            return x
+        """
+
+    CLEAN = """\
+        import jax
+
+        def _step(x):
+            return x * 2
+
+        step = jax.jit(_step)
+
+        def run(xs):
+            return [step(x) for x in xs]
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "JX003", "g = jax.jit(fn)")
+        assert "loop" in f.message
+
+    def test_clean_twin(self):
+        assert_quiet(self.CLEAN, "JX003")
+
+    def test_per_call_lambda(self):
+        src = """\
+            import jax
+
+            def apply(x, scale):
+                f = jax.jit(lambda v: v * scale)
+                return f(x)
+            """
+        assert_fires(src, "JX003", "lambda v: v * scale")
+
+
+class TestJX004HostSyncInHotPath:
+    VIOLATION = """\
+        def train_loop(batches, step):
+            total = 0.0
+            for batch in batches:
+                loss = step(batch)
+                total += float(loss)
+            return total
+        """
+
+    CLEAN = """\
+        def train_loop(batches, step):
+            losses = []
+            for batch in batches:
+                losses.append(step(batch))
+            return sum(float(x) for x in losses)
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "JX004", "total += float(loss)")
+        assert f.severity == Severity.WARNING
+        assert "sync" in f.message
+
+    def test_clean_twin(self):
+        # deferred conversion after the loop is the recommended rewrite
+        assert_quiet(self.CLEAN, "JX004")
+
+    def test_cold_function_not_flagged(self):
+        # same shape, but the function name is not a hot-path name
+        src = self.VIOLATION.replace("train_loop", "summarize")
+        assert_quiet(src, "JX004")
+
+    def test_loop_header_not_flagged(self):
+        # the For iter is evaluated once, not per iteration
+        src = """\
+            import numpy as np
+
+            def decode_all(ids):
+                out = []
+                for i in np.asarray(ids).tolist():
+                    out.append(i)
+                return out
+            """
+        assert_quiet(src, "JX004")
+
+
+class TestRT001BlockingInActor:
+    VIOLATION = """\
+        import time
+        import tpu_air
+
+        @tpu_air.remote
+        class Worker:
+            def ping(self):
+                time.sleep(1.0)
+                return "ok"
+        """
+
+    CLEAN = """\
+        import time
+        import tpu_air
+
+        @tpu_air.remote
+        class Worker:
+            def ping(self):
+                return "ok"
+
+        def wait_outside():
+            time.sleep(1.0)
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "RT001", "time.sleep(1.0)")
+        assert "Worker.ping" in f.message
+
+    def test_clean_twin(self):
+        assert_quiet(self.CLEAN, "RT001")
+
+    def test_wrapped_form(self):
+        # remote(**opts)(Cls) must count as an actor class too
+        src = """\
+            import time
+            from tpu_air import remote
+
+            class Worker:
+                def ping(self):
+                    time.sleep(1.0)
+
+            WorkerActor = remote(num_cpus=1)(Worker)
+            """
+        assert_fires(src, "RT001", "time.sleep(1.0)")
+
+
+class TestRT002MutateAfterPut:
+    VIOLATION = """\
+        def publish(store, batch):
+            ref = store.put(batch)
+            batch.append(1)
+            return ref
+        """
+
+    CLEAN = """\
+        def publish(store, batch):
+            ref = store.put(batch)
+            batch = list(batch)
+            batch.append(1)
+            return ref
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "RT002", "batch.append(1)")
+        assert f.severity == Severity.ERROR
+
+    def test_clean_twin(self):
+        # rebinding stops the tracking: the stored snapshot is not aliased
+        assert_quiet(self.CLEAN, "RT002")
+
+    def test_subscript_store(self):
+        src = """\
+            def publish(store, cfg):
+                ref = store.put(cfg)
+                cfg["epoch"] = 2
+                return ref
+            """
+        assert_fires(src, "RT002", 'cfg["epoch"] = 2')
+
+
+class TestRT003BroadExcept:
+    VIOLATION = """\
+        def fetch(loader):
+            try:
+                return loader()
+            except Exception:
+                return None
+        """
+
+    CLEAN = """\
+        def fetch(loader):
+            try:
+                return loader()
+            except Exception:  # loader failures degrade to a cache miss
+                return None
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "RT003", "except Exception:")
+        assert f.severity == Severity.WARNING
+
+    def test_clean_twin(self):
+        assert_quiet(self.CLEAN, "RT003")
+
+    def test_bare_except(self):
+        src = """\
+            def fetch(loader):
+                try:
+                    return loader()
+                except:
+                    return None
+            """
+        assert_fires(src, "RT003", "except:")
+
+    def test_noqa_alone_is_not_justification(self):
+        # a directive is not prose: the breadth still needs a stated reason
+        src = self.CLEAN.replace(
+            "# loader failures degrade to a cache miss", "# noqa: BLE001")
+        assert_fires(src, "RT003", "except Exception:")
+
+
+class TestRT004NonStaticStaticArg:
+    VIOLATION = """\
+        import jax
+
+        def _reshape(x, shape):
+            return x.reshape(shape)
+
+        reshape = jax.jit(_reshape, static_argnums=(1,))
+
+        def run(x):
+            return reshape(x, [4, 4])
+        """
+
+    CLEAN = """\
+        import jax
+
+        def _reshape(x, shape):
+            return x.reshape(shape)
+
+        reshape = jax.jit(_reshape, static_argnums=(1,))
+
+        def run(x):
+            return reshape(x, (4, 4))
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "RT004", "[4, 4]")
+        assert "unhashable" in f.message
+
+    def test_clean_twin(self):
+        assert_quiet(self.CLEAN, "RT004")
+
+
+class TestAL000ParseError:
+    def test_syntax_error_is_a_finding(self):
+        rep = analyze_source("def broken(:\n    pass\n", path="bad.py")
+        assert [f.rule for f in rep.active] == ["AL000"]
+        assert rep.active[0].severity == Severity.ERROR
+
+
+def test_every_rule_has_a_fixture():
+    """Adding a rule without a fires+quiet fixture pair must fail CI."""
+    covered = {"JX001", "JX002", "JX003", "JX004",
+               "RT001", "RT002", "RT003", "RT004"}
+    assert {r.id for r in all_rules()} == covered
+
+
+# ---------------------------------------------------------------------------
+# suppression parsing
+# ---------------------------------------------------------------------------
+
+HOT = """\
+    def train_loop(batches, step):
+        total = 0.0
+        for batch in batches:
+            loss = step(batch)
+            total += float(loss){comment}
+        return total
+    """
+
+
+class TestSuppressions:
+    def test_reasoned_trailing_suppression(self):
+        rep = check(HOT.format(
+            comment="  # airlint: disable=JX004 — fixture: epoch cadence"))
+        assert not rep.active
+        assert [f.rule for f in rep.suppressed] == ["JX004"]
+        assert rep.suppressed[0].suppress_reason == "fixture: epoch cadence"
+
+    def test_reasonless_suppression_is_inert_and_reported(self):
+        rep = check(HOT.format(comment="  # airlint: disable=JX004"))
+        # the original finding survives AND the bad suppression is flagged
+        assert sorted(f.rule for f in rep.active) == ["AL001", "JX004"]
+        assert not rep.suppressed
+
+    def test_unknown_rule_id_is_reported(self):
+        rep = check(HOT.format(
+            comment="  # airlint: disable=ZZ999 — no such rule"))
+        assert "AL002" in [f.rule for f in rep.active]
+
+    def test_standalone_comment_covers_next_code_line(self):
+        src = """\
+            def train_loop(batches, step):
+                total = 0.0
+                for batch in batches:
+                    loss = step(batch)
+                    # airlint: disable=JX004 — fixture: epoch cadence
+                    total += float(loss)
+                return total
+            """
+        rep = check(src)
+        assert not rep.active
+        assert [f.rule for f in rep.suppressed] == ["JX004"]
+
+    def test_file_level_suppression(self):
+        src = ("# airlint: disable-file=JX004 — fixture: whole file opts out\n"
+               + textwrap.dedent(HOT.format(comment="")))
+        rep = analyze_source(src, path="fix.py")
+        assert not rep.active
+        assert [f.rule for f in rep.suppressed] == ["JX004"]
+
+    def test_suppression_does_not_leak_to_other_lines(self):
+        src = """\
+            def train_loop(batches, step):
+                total = 0.0
+                for batch in batches:
+                    loss = step(batch)
+                    total += float(loss)  # airlint: disable=JX004 — fixture
+                    extra = float(loss)
+                return total
+            """
+        rep = check(src)
+        assert [f.rule for f in rep.active] == ["JX004"]
+        assert rep.active[0].line == line_of(src, "extra = float(loss)")
+
+    def test_meta_findings_are_never_suppressible(self):
+        src = """\
+            # airlint: disable-file=AL001 — trying to silence the meta rule
+            def train_loop(batches, step):
+                total = 0.0
+                for batch in batches:
+                    loss = step(batch)
+                    total += float(loss)  # airlint: disable=JX004
+                return total
+            """
+        rep = check(src)
+        assert "AL001" in [f.rule for f in rep.active]
+
+
+# ---------------------------------------------------------------------------
+# self-application + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_self_application_zero_unsuppressed():
+    """The repo's own tree must be airlint-clean: every remaining hit
+    carries a reasoned suppression."""
+    reports = analyze_paths([str(REPO / "tpu_air")])
+    active = [f for rep in reports for f in rep.active]
+    assert not active, "unsuppressed airlint findings:\n" + "\n".join(
+        f"  {f.location()}: {f.rule}: {f.message}" for f in active)
+    for f in (f for rep in reports for f in rep.suppressed):
+        assert f.suppress_reason, f"reason-less suppression at {f.location()}"
+
+
+def test_analysis_package_never_imports_jax():
+    """The analyzer must stay importable (and fast) on jax-free boxes."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, tpu_air.analysis; "
+         "sys.exit(1 if 'jax' in sys.modules else 0)"],
+        capture_output=True, text=True, cwd=str(REPO), timeout=60)
+    assert proc.returncode == 0, proc.stderr
+
+
+class TestCLI:
+    def test_exit_zero_on_clean_file(self, tmp_path, capsys):
+        p = tmp_path / "clean.py"
+        p.write_text("x = 1\n")
+        assert cli_main([str(p)]) == 0
+        assert "0 error(s), 0 warning(s)" in capsys.readouterr().out
+
+    def test_exit_one_on_findings(self, tmp_path, capsys):
+        p = tmp_path / "bad.py"
+        p.write_text(textwrap.dedent(TestRT002MutateAfterPut.VIOLATION))
+        assert cli_main([str(p)]) == 1
+        out = capsys.readouterr().out
+        assert f"{p}:3:" in out and "RT002" in out
+
+    def test_exit_two_on_unknown_rule(self, tmp_path, capsys):
+        p = tmp_path / "clean.py"
+        p.write_text("x = 1\n")
+        assert cli_main([str(p), "--rules", "NOPE"]) == 2
+
+    def test_rules_filter(self, tmp_path, capsys):
+        p = tmp_path / "bad.py"
+        p.write_text(textwrap.dedent(TestRT002MutateAfterPut.VIOLATION))
+        assert cli_main([str(p), "--rules", "RT003"]) == 0
+
+    def test_json_schema(self, tmp_path, capsys):
+        p = tmp_path / "bad.py"
+        p.write_text(textwrap.dedent(TestRT002MutateAfterPut.VIOLATION))
+        assert cli_main([str(p), "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == 1
+        assert doc["files_analyzed"] == 1
+        (finding,) = doc["findings"]
+        assert finding["rule"] == "RT002"
+        assert finding["severity"] == "error"
+        assert {"path", "line", "col", "message"} <= set(finding)
+        assert doc["suppressed"] == []
+
+    def test_list_rules(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("JX001", "JX004", "RT001", "RT004"):
+            assert rid in out
+
+    def test_tools_launcher_json_gate(self, tmp_path):
+        """tools/airlint.py --json must exit nonzero on findings — this is
+        the exact invocation CI gates on."""
+        p = tmp_path / "bad.py"
+        p.write_text(textwrap.dedent(TestJX004HostSyncInHotPath.VIOLATION))
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "airlint.py"),
+             "--json", str(p)],
+            capture_output=True, text=True, cwd=str(REPO), timeout=60)
+        assert proc.returncode == 1, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert [f["rule"] for f in doc["findings"]] == ["JX004"]

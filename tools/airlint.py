@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""airlint launcher — works from any cwd without installing the package.
+
+CI gate usage (nonzero exit on any unsuppressed finding)::
+
+    python tools/airlint.py --json tpu_air/
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_air.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

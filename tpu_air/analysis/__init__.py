@@ -1,0 +1,81 @@
+"""airlint — AST-based JAX/TPU + actor-runtime hazard analyzer.
+
+The classic failure modes of this stack are invisible until production:
+silent recompilation, use-after-donate, host-device sync stalls, tracer
+leaks, and pickle-object-store aliasing.  All of them are *statically
+checkable* shapes in the AST, so airlint checks them — over ``tpu_air/``
+itself in tier-1 CI (tests/test_airlint.py) and over any tree via::
+
+    python -m tpu_air.analysis tpu_air/            # human output
+    python -m tpu_air.analysis --json tpu_air/     # machine output, rc=1 on findings
+
+Rule catalog + suppression syntax: docs/ANALYSIS.md.  Pure stdlib — no jax
+import anywhere in this package, so it runs in milliseconds on any box.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional
+
+from .findings import FileReport, Finding, Severity  # noqa: F401 — re-export
+from .registry import (  # noqa: F401 — re-export
+    META_RULES,
+    Rule,
+    all_rules,
+    known_rule_ids,
+    rule,
+    select_rules,
+)
+
+# importing the rule modules populates the registry
+from . import rules_jax as _rules_jax  # noqa: E402,F401
+from . import rules_runtime as _rules_runtime  # noqa: E402,F401
+from .context import ModuleContext
+from .suppressions import apply_suppressions, parse_suppressions
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   only: Optional[Iterable[str]] = None) -> FileReport:
+    """Run the (selected) rule set over one source string."""
+    report = FileReport(path=path)
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as e:
+        report.findings.append(Finding(
+            "AL000", Severity.ERROR, path, e.lineno or 1, e.offset or 0,
+            f"file does not parse: {e.msg}"))
+        return report
+    findings: List[Finding] = []
+    for r in select_rules(only):
+        findings.extend(r.check(ctx))
+    idx = parse_suppressions(ctx, known_rule_ids())
+    apply_suppressions(idx, findings)
+    findings.extend(idx.meta_findings)
+    report.findings = sorted(findings, key=Finding.sort_key)
+    return report
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if not d.startswith(".") and d != "__pycache__")
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return out
+
+
+def analyze_paths(paths: Iterable[str],
+                  only: Optional[Iterable[str]] = None) -> List[FileReport]:
+    reports = []
+    for fpath in iter_python_files(paths):
+        with open(fpath, "r", encoding="utf-8") as f:
+            source = f.read()
+        reports.append(analyze_source(source, path=fpath, only=only))
+    return reports

@@ -1,0 +1,7 @@
+"""``python -m tpu_air.analysis`` entry point."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

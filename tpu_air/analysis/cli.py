@@ -1,0 +1,93 @@
+"""airlint CLI.
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error.  ``--json``
+emits the schema documented in docs/ANALYSIS.md (stable: version bumps on
+breaking change) so CI and tooling can gate on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import analyze_paths, all_rules
+from .findings import Severity
+
+JSON_SCHEMA_VERSION = 1
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="airlint",
+        description="AST-based JAX/TPU + actor-runtime hazard analyzer",
+    )
+    p.add_argument("paths", nargs="*", default=["tpu_air"],
+                   help="files or directories to analyze (default: tpu_air)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit machine-readable JSON on stdout")
+    p.add_argument("--rules", default=None, metavar="ID[,ID...]",
+                   help="run only these rule ids")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print findings silenced by suppressions")
+    return p
+
+
+def _list_rules() -> None:
+    for r in sorted(all_rules(), key=lambda r: r.id):
+        print(f"{r.id}  {r.severity:<7}  {r.name}")
+        print(f"       {r.rationale}")
+
+
+def _human(reports, show_suppressed: bool) -> None:
+    for rep in reports:
+        shown = rep.findings if show_suppressed else rep.active
+        for f in shown:
+            mark = " [suppressed]" if f.suppressed else ""
+            print(f"{f.location()}: {f.rule} {f.severity}: {f.message}{mark}")
+
+
+def _json_out(reports) -> None:
+    active = [f for rep in reports for f in rep.active]
+    suppressed = [f for rep in reports for f in rep.suppressed]
+    print(json.dumps({
+        "version": JSON_SCHEMA_VERSION,
+        "files_analyzed": len(reports),
+        "findings": [f.to_dict() for f in active],
+        "suppressed": [f.to_dict() for f in suppressed],
+    }, indent=2))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        _list_rules()
+        return 0
+    only = args.rules.split(",") if args.rules else None
+    try:
+        reports = analyze_paths(args.paths, only=only)
+    except KeyError as e:
+        print(f"airlint: {e.args[0]}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"airlint: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        _json_out(reports)
+    else:
+        _human(reports, args.show_suppressed)
+    active = [f for rep in reports for f in rep.active]
+    n_sup = sum(len(rep.suppressed) for rep in reports)
+    if not args.as_json:
+        errors = sum(f.severity == Severity.ERROR for f in active)
+        warnings = len(active) - errors
+        print(f"airlint: {len(reports)} file(s), {errors} error(s), "
+              f"{warnings} warning(s), {n_sup} suppressed")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""Shared per-module AST infrastructure for airlint rules.
+
+One :class:`ModuleContext` is built per file: parse tree, parent links,
+comment map, and the jit/donation tables most rules need.  Everything here
+is pure ``ast``/``tokenize`` — importing this module must never pull in jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import tokenize
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# Dotted names that denote jax's compile entry points.  ``jit`` bare is
+# accepted because ``from jax import jit`` is idiomatic.
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _int_literals(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Evaluate an int or tuple/list-of-ints literal; None if not literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+class JitInfo:
+    """What a jit wrapping declared: donated / static positional indices."""
+
+    def __init__(self, node: ast.AST, donate=(), static=()):
+        self.node = node
+        self.donate: Tuple[int, ...] = donate
+        self.static: Tuple[int, ...] = static
+
+
+def jit_call_info(call: ast.Call) -> Optional[JitInfo]:
+    """If ``call`` is ``jax.jit(...)``/``pjit(...)`` or
+    ``partial(jax.jit, ...)``, return its declared argnums."""
+    fname = dotted(call.func)
+    if fname in PARTIAL_NAMES and call.args and dotted(call.args[0]) in JIT_NAMES:
+        pass  # partial(jax.jit, **kw) — kwargs carry the argnums
+    elif fname not in JIT_NAMES:
+        return None
+    donate: Tuple[int, ...] = ()
+    static: Tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            donate = _int_literals(kw.value) or ()
+        elif kw.arg in ("static_argnums", "static_argnames"):
+            static = _int_literals(kw.value) or ()
+    return JitInfo(call, donate, static)
+
+
+def jit_decoration(fn: ast.AST) -> Optional[JitInfo]:
+    """If a function def is jit-decorated (``@jax.jit``, ``@partial(jax.jit,
+    ...)``, ``@jax.jit(...)`` factory form), return its JitInfo."""
+    for deco in getattr(fn, "decorator_list", []):
+        if dotted(deco) in JIT_NAMES:
+            return JitInfo(deco)
+        if isinstance(deco, ast.Call):
+            info = jit_call_info(deco)
+            if info is not None:
+                return info
+    return None
+
+
+class ModuleContext:
+    """Parse tree + derived tables for one source file."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self.nodes: List[ast.AST] = [self.tree]
+        for parent in self.nodes:  # grows while iterating: preorder walk
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+                self.nodes.append(child)
+        self.comments = self._comment_map(source)
+        self._jitted_functions = None
+        self._jit_wrapped_names = None
+
+    # -- structure -----------------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def enclosing_loop(self, node: ast.AST):
+        """Nearest For/While ancestor *within* the same function scope."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return None
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                return anc
+        return None
+
+    def enclosing_statement(self, node: ast.AST) -> ast.stmt:
+        """The statement that directly contains ``node`` inside the nearest
+        statement-list (function/module/loop body)."""
+        cur = node
+        for anc in self.ancestors(node):
+            if isinstance(cur, ast.stmt) and hasattr(anc, "body"):
+                return cur
+            cur = anc
+        return cur  # pragma: no cover — node was the module itself
+
+    # -- jit tables ----------------------------------------------------------
+    def jitted_functions(self) -> List[Tuple[ast.AST, JitInfo]]:
+        """Every function def in the module carrying a jit decoration."""
+        if self._jitted_functions is None:
+            out = []
+            for node in self.nodes:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = jit_decoration(node)
+                    if info is not None:
+                        out.append((node, info))
+            self._jitted_functions = out
+        return self._jitted_functions
+
+    def jit_wrapped_names(self) -> Dict[str, JitInfo]:
+        """Names bound to jit-wrapped callables visible at module analysis:
+        ``@jit``-decorated defs (by def name, free functions only — method
+        call sites shift positional indices by ``self``) and
+        ``g = jax.jit(f, ...)`` assignments (by target name)."""
+        if self._jit_wrapped_names is not None:
+            return self._jit_wrapped_names
+        table: Dict[str, JitInfo] = {}
+        for fn, info in self.jitted_functions():
+            if self.enclosing_class(fn) is None:
+                table[fn.name] = info
+        for node in self.nodes:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                info = jit_call_info(node.value)
+                # partial(jax.jit, ...) only *configures* jit; the name is
+                # jit-wrapped only when jit itself was called on a function
+                if info is not None and dotted(node.value.func) in JIT_NAMES:
+                    table[node.targets[0].id] = info
+        self._jit_wrapped_names = table
+        return table
+
+    # -- comments ------------------------------------------------------------
+    @staticmethod
+    def _comment_map(source: str) -> Dict[int, Tuple[int, str]]:
+        """{line -> (col, comment_text_without_hash)} via tokenize (immune
+        to '#' inside string literals)."""
+        out: Dict[int, Tuple[int, str]] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = (tok.start[1], tok.string.lstrip("#").strip())
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            pass  # comment-dependent rules degrade gracefully
+        return out
+
+    def comment_on(self, line: int) -> Optional[str]:
+        entry = self.comments.get(line)
+        return entry[1] if entry else None
+
+    def comment_is_standalone(self, line: int) -> bool:
+        """True when line ``line`` holds only a comment (no code)."""
+        entry = self.comments.get(line)
+        if entry is None:
+            return False
+        lines = self.source.splitlines()
+        if not (1 <= line <= len(lines)):
+            return False
+        return lines[line - 1][: entry[0]].strip() == ""

@@ -1,0 +1,80 @@
+"""Pluggable rule registry.
+
+A rule is a function ``check(ctx: ModuleContext) -> Iterable[Finding]``
+registered with :func:`rule`.  Registration order is the report order for
+ties; rule ids must be unique.  External plugins can call :func:`rule`
+directly — the CLI discovers everything through :func:`all_rules`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List
+
+from .findings import Finding, Severity
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str
+    rationale: str
+    check: Callable
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+# Meta rule ids emitted by the suppression parser itself (no check function):
+# AL001 — suppression without a reason; AL002 — suppression of an unknown rule.
+META_RULES = {
+    "AL000": Rule("AL000", "parse-error", Severity.ERROR,
+                  "a file that does not parse cannot be analyzed", None),
+    "AL001": Rule("AL001", "suppression-without-reason", Severity.ERROR,
+                  "every suppression must explain itself or it rots", None),
+    "AL002": Rule("AL002", "suppression-of-unknown-rule", Severity.ERROR,
+                  "a typoed rule id silently disables nothing", None),
+}
+
+
+def rule(id: str, name: str, severity: str, rationale: str):
+    """Decorator: register ``check(ctx) -> Iterable[Finding]`` under ``id``."""
+
+    def deco(fn):
+        if id in _REGISTRY or id in META_RULES:
+            raise ValueError(f"duplicate airlint rule id {id!r}")
+        _REGISTRY[id] = Rule(id, name, severity, rationale, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> List[Rule]:
+    return list(_REGISTRY.values())
+
+
+def known_rule_ids() -> set:
+    return set(_REGISTRY) | set(META_RULES)
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _REGISTRY.get(rule_id) or META_RULES[rule_id]
+
+
+def select_rules(only: Iterable[str] = None) -> List[Rule]:
+    rules = all_rules()
+    if only is None:
+        return rules
+    only = set(only)
+    unknown = only - {r.id for r in rules}
+    if unknown:
+        raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
+    return [r for r in rules if r.id in only]
+
+
+def make_finding(ctx, rule_id: str, node, message: str) -> Finding:
+    """Finding at an AST node's location, severity from the registry."""
+    r = get_rule(rule_id)
+    return Finding(rule=rule_id, severity=r.severity, path=ctx.path,
+                   line=getattr(node, "lineno", 1),
+                   col=getattr(node, "col_offset", 0), message=message)

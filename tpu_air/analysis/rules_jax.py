@@ -1,0 +1,327 @@
+"""JAX/TPU hazard rules: JX001–JX004.
+
+These are heuristics over a single module's AST — no type inference, no
+cross-module dataflow.  They are tuned to catch the classic failure modes
+(tracer leaks, use-after-donate, per-call recompilation, host-device sync
+in hot loops) with a low false-positive rate; intentional hits are
+documented with ``# airlint: disable=RULE — reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .context import JIT_NAMES, PARTIAL_NAMES, ModuleContext, dotted, jit_call_info
+from .findings import Finding, Severity
+from .registry import make_finding, rule
+
+# ---------------------------------------------------------------------------
+# JX001 — tracer leak
+# ---------------------------------------------------------------------------
+
+
+@rule("JX001", "tracer-leak", Severity.ERROR,
+      "values assigned to self.*/globals inside a jit trace are abstract "
+      "tracers; reading them later raises or silently pins stale state")
+def jx001_tracer_leak(ctx: ModuleContext) -> List[Finding]:
+    out = []
+    for fn, _info in ctx.jitted_functions():
+        # Everything under the jitted def runs during trace — nested helper
+        # defs included — so walk the whole subtree.
+        global_names = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                global_names.update(node.names)
+        for node in ast.walk(fn):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                for leaf in ast.walk(tgt):
+                    name = dotted(leaf) if isinstance(leaf, ast.Attribute) else None
+                    if name is not None and name.startswith("self."):
+                        out.append(make_finding(
+                            ctx, "JX001", leaf,
+                            f"`{name}` assigned inside jit-compiled "
+                            f"`{fn.name}` — traced values leak out of the "
+                            "trace; return the value instead"))
+                    elif (isinstance(leaf, ast.Name)
+                          and leaf.id in global_names
+                          and isinstance(leaf.ctx, ast.Store)):
+                        out.append(make_finding(
+                            ctx, "JX001", leaf,
+                            f"global `{leaf.id}` assigned inside "
+                            f"jit-compiled `{fn.name}` — traced values leak "
+                            "out of the trace; return the value instead"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JX002 / RT004 shared call-site machinery
+# ---------------------------------------------------------------------------
+
+
+def _stmt_rebinds(stmt: ast.stmt, name: str) -> bool:
+    """Does this statement's assignment target rebind ``name``?"""
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for tgt in targets:
+        for leaf in ast.walk(tgt):
+            if (isinstance(leaf, ast.Name) and leaf.id == name
+                    and isinstance(leaf.ctx, ast.Store)):
+                return True
+    return False
+
+
+def _pos(node: ast.AST) -> Tuple[int, int]:
+    return (node.lineno, node.col_offset)
+
+
+def _end(node: ast.AST) -> Tuple[int, int]:
+    return (getattr(node, "end_lineno", node.lineno),
+            getattr(node, "end_col_offset", node.col_offset))
+
+
+def _name_events(scope: ast.AST, name: str):
+    """All (pos, node, is_load) for ``name`` under ``scope``, source order.
+    AugAssign targets read before writing, so they count as loads."""
+    aug_targets = {
+        node.target for node in ast.walk(scope)
+        if isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Name)
+    }
+    events = []
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Name) and node.id == name:
+            is_load = (not isinstance(node.ctx, ast.Store)
+                       or node in aug_targets)
+            events.append((_pos(node), node, is_load))
+    events.sort(key=lambda e: e[0])
+    return events
+
+
+def _first_use_after(ctx: ModuleContext, call: ast.Call, arg: ast.Name):
+    """Classify the first use of ``arg.id`` after ``call``.
+
+    Returns one of ``None`` (no later use / rebound first), or the offending
+    Load node.  Handles the three shapes that matter:
+
+    * ``x = f(x)``       — rebinding in the call's own statement: safe
+    * ``y = f(x) + x``   — extra load in the same statement: hazard
+    * loop wrap-around   — call in a loop, x not rebound: any load in the
+      loop on another line is a hazard on the next iteration
+    """
+    scope = ctx.enclosing_function(call) or ctx.tree
+    stmt = ctx.enclosing_statement(call)
+    name = arg.id
+    call_span = (_pos(call), _end(call))
+
+    def in_call(node) -> bool:
+        return call_span[0] <= _pos(node) <= call_span[1]
+
+    # same-statement loads outside the call expression itself
+    for node in ast.walk(stmt):
+        if (isinstance(node, ast.Name) and node.id == name
+                and isinstance(node.ctx, ast.Load) and not in_call(node)):
+            return node
+
+    if _stmt_rebinds(stmt, name):
+        return None
+
+    # loop wrap-around: donated x still referenced by the next iteration.
+    # If nothing in the loop body rebinds x, even the call's own argument
+    # re-reads the dead buffer on iteration 2 — report the arg itself.
+    loop = ctx.enclosing_loop(call)
+    if loop is not None:
+        rebound = any(
+            isinstance(node, ast.stmt) and _stmt_rebinds(node, name)
+            for node in ast.walk(loop))
+        if not rebound:
+            return arg
+        for node in ast.walk(loop):
+            if (isinstance(node, ast.Name) and node.id == name
+                    and isinstance(node.ctx, ast.Load) and not in_call(node)):
+                return node
+
+    # linear scan: first event after the statement decides
+    stmt_end = _end(stmt)
+    for pos, node, is_load in _name_events(scope, name):
+        if pos <= stmt_end:
+            continue
+        return node if is_load else None
+    return None
+
+
+def _jit_call_sites(ctx: ModuleContext):
+    """Yield (call, JitInfo) for calls of module-local jit-wrapped names,
+    plus immediately-invoked ``jax.jit(f, ...)(args)`` forms."""
+    table = ctx.jit_wrapped_names()
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name) and node.func.id in table:
+            info = table[node.func.id]
+            # skip the defining assignment's own RHS (g = jax.jit(g, ...))
+            if jit_call_info(node) is None:
+                yield node, info
+        elif isinstance(node.func, ast.Call):
+            info = jit_call_info(node.func)
+            if info is not None and dotted(node.func.func) in JIT_NAMES:
+                yield node, info
+
+
+@rule("JX002", "use-after-donate", Severity.ERROR,
+      "a buffer passed in a donate_argnums position is invalidated by the "
+      "call; reading it afterwards returns garbage or raises on TPU")
+def jx002_use_after_donate(ctx: ModuleContext) -> List[Finding]:
+    out = []
+    for call, info in _jit_call_sites(ctx):
+        for pos_i in info.donate:
+            if pos_i >= len(call.args):
+                continue
+            arg = call.args[pos_i]
+            if not isinstance(arg, ast.Name):
+                continue  # attribute/expr dataflow is out of scope
+            offender = _first_use_after(ctx, call, arg)
+            if offender is not None:
+                out.append(make_finding(
+                    ctx, "JX002", offender,
+                    f"`{arg.id}` was donated to the jitted call on line "
+                    f"{call.lineno} (donate_argnums position {pos_i}) and is "
+                    "read afterwards — rebind the result to the same name "
+                    "or stop donating it"))
+    return out
+
+
+@rule("RT004", "non-static-static-arg", Severity.ERROR,
+      "static_argnums values are hashed into the compile cache key; "
+      "unhashable literals raise, fresh objects retrace every call")
+def rt004_static_argnums(ctx: ModuleContext) -> List[Finding]:
+    out = []
+    unhashable = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                  ast.SetComp, ast.GeneratorExp)
+    for call, info in _jit_call_sites(ctx):
+        for pos_i in info.static:
+            if pos_i >= len(call.args):
+                continue
+            arg = call.args[pos_i]
+            if isinstance(arg, unhashable):
+                out.append(make_finding(
+                    ctx, "RT004", arg,
+                    f"unhashable {type(arg).__name__.lower()} literal in "
+                    f"static_argnums position {pos_i} — static args must be "
+                    "hashable (use a tuple or pass it as a traced arg)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JX003 — recompile hazard
+# ---------------------------------------------------------------------------
+
+
+def _is_jit_constructor(call: ast.Call) -> bool:
+    fname = dotted(call.func)
+    if fname in JIT_NAMES:
+        return True
+    # partial(jax.jit, ...) builds a jit constructor — invoking it per
+    # iteration still mints a fresh compiled callable each time
+    return (fname in PARTIAL_NAMES and bool(call.args)
+            and dotted(call.args[0]) in JIT_NAMES)
+
+
+@rule("JX003", "recompile-hazard", Severity.WARNING,
+      "jax.jit caches by wrapped-function identity; wrapping inside a loop "
+      "or around a per-call lambda compiles from scratch every time")
+def jx003_recompile_hazard(ctx: ModuleContext) -> List[Finding]:
+    out = []
+    for node in ctx.nodes:
+        if not (isinstance(node, ast.Call) and _is_jit_constructor(node)):
+            continue
+        if ctx.enclosing_loop(node) is not None:
+            out.append(make_finding(
+                ctx, "JX003", node,
+                "jax.jit invoked inside a loop body — each iteration mints "
+                "a new wrapped callable and recompiles; hoist the jit out "
+                "of the loop"))
+            continue
+        if (node.args and isinstance(node.args[0], ast.Lambda)
+                and ctx.enclosing_function(node) is not None):
+            out.append(make_finding(
+                ctx, "JX003", node,
+                "jax.jit over a lambda created per call — the fresh lambda "
+                "defeats the compile cache; define the function once at "
+                "module or factory scope"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JX004 — host sync in a hot loop
+# ---------------------------------------------------------------------------
+
+HOT_NAME = re.compile(r"(^|_)(step|decode|train|generate)")
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "jax.device_get", "device_get"}
+
+
+def _hot_function(ctx: ModuleContext, node: ast.AST):
+    # direct enclosing function only — a helper nested inside a hot loop fn
+    # (e.g. a batch-staging closure over host data) is not itself hot
+    fn = ctx.enclosing_function(node)
+    if fn is not None and HOT_NAME.search(fn.name):
+        return fn
+    return None
+
+
+def _in_loop_header(ctx: ModuleContext, node: ast.AST, loop: ast.AST) -> bool:
+    """True when ``node`` sits in a For's iter/target — evaluated once at
+    loop entry, not per iteration (While tests DO run per iteration)."""
+    if not isinstance(loop, (ast.For, ast.AsyncFor)):
+        return False
+    for header in (loop.iter, loop.target):
+        for sub in ast.walk(header):
+            if sub is node:
+                return True
+    return False
+
+
+@rule("JX004", "host-sync-in-hot-path", Severity.WARNING,
+      "pulling device values to the host inside a step/decode loop blocks "
+      "async dispatch and serializes the device every iteration")
+def jx004_host_sync(ctx: ModuleContext) -> List[Finding]:
+    out = []
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        loop = ctx.enclosing_loop(node)
+        if loop is None or _in_loop_header(ctx, node, loop):
+            continue
+        fn = _hot_function(ctx, node)
+        if fn is None:
+            continue
+        desc = None
+        fname = dotted(node.func)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_ATTRS and not node.args):
+            desc = f".{node.func.attr}()"
+        elif fname in _SYNC_CALLS and node.args:
+            desc = f"{fname}(...)"
+        elif (fname in ("float", "int") and len(node.args) == 1
+              and isinstance(node.args[0],
+                             (ast.Name, ast.Subscript))):
+            # bare-name/subscript args only: float(loss), int(tok[0]) are
+            # device pulls; int(args.epochs) / float(np.mean(..)) are host
+            desc = f"{fname}(...)"
+        if desc is not None:
+            out.append(make_finding(
+                ctx, "JX004", node,
+                f"{desc} inside the `{fn.name}` loop forces a host-device "
+                "sync every iteration — batch the transfer outside the "
+                "loop or keep the value on device"))
+    return out

@@ -316,7 +316,7 @@ class ObjectStore:
 
         try:
             value, holders = serialization.deserialize_pinned(view)
-        except BaseException:
+        except BaseException:  # unpin on ANY failure (even KeyboardInterrupt), then surface
             self._arena.unpin(object_id, offset)
             raise
         if not holders:

@@ -1372,7 +1372,7 @@ def shutdown():
             from tpu_air.observability import stop_dashboard
 
             stop_dashboard()
-        except Exception:
+        except Exception:  # noqa: BLE001 — shutdown is best-effort; dashboard may never have started
             pass
         _runtime.shutdown()
         _runtime = None

@@ -154,5 +154,5 @@ def open_arena(root: str, create: bool) -> Optional[Arena]:
     path = os.path.join(root, "__arena__")
     try:
         return Arena(path, create=create)
-    except Exception:
+    except Exception:  # noqa: BLE001 — any native failure degrades to the file store
         return None

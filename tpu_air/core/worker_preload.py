@@ -10,6 +10,8 @@ re-import everything).
 IMPORTANT: modules only — nothing here may initialize a jax backend or touch
 devices; children initialize their own backends on first use.
 """
+# airlint: disable-file=RT003 — every preload import is optional: a failure
+# here only means the worker pays that import lazily on first use
 
 try:  # noqa: SIM105
     import numpy  # noqa: F401

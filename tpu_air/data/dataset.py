@@ -408,7 +408,7 @@ class Dataset:
                 try:
                     if a._ready_ref is not None:
                         get(a._ready_ref)
-                except Exception:
+                except Exception:  # noqa: BLE001 — ctor failure arrives as an arbitrary unpickled error
                     kill(a)
                     scale_blocked = True
                     return False

@@ -49,6 +49,7 @@ from .slots import Slot, SlotManager, make_insert_fn
 from .types import (
     EngineClosedError,
     EngineConfig,
+    EngineOverloadedError,
     Request,
     ResponseStream,
 )
@@ -130,7 +131,7 @@ class InferenceEngine:
                       stream=stream)
         try:
             self.scheduler.submit(req)
-        except Exception:
+        except EngineOverloadedError:  # backpressure: count the 503, surface it
             self.metrics.record_reject()
             raise
         self.metrics.record_submit()
@@ -210,6 +211,8 @@ class InferenceEngine:
         dt = time.monotonic() - t0
         emitted = 0
         for slot in self.slots.active_slots():
+            # airlint: disable=JX004 — nxt is the np.asarray'd step result;
+            # the single device sync already happened above the loop
             token = int(nxt[slot.index])
             slot.request.stream._emit(token)
             emitted += 1

@@ -157,7 +157,7 @@ def load_segformer_from_hf(
         from transformers import SegformerForSemanticSegmentation as TorchSeg
 
         torch_model = TorchSeg.from_pretrained(name_or_path)
-    except Exception:
+    except Exception:  # noqa: BLE001 — head-ful load fails on bare backbones; retry as AutoModel
         torch_model = AutoModel.from_pretrained(name_or_path)
     sd = {k: v.detach().cpu().numpy() for k, v in torch_model.state_dict().items()}
     # Bare-backbone checkpoints (AutoModel → SegformerModel) lack the

@@ -133,13 +133,13 @@ def auto_tokenizer(name_or_path: str, strict: bool = False):
         from transformers import AutoTokenizer
 
         return AutoTokenizer.from_pretrained(name_or_path)
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001 — any backend failure falls through to the next loader
         errors.append(f"transformers.AutoTokenizer: {type(e).__name__}: {e}")
     try:
         from .sentencepiece_unigram import T5SentencePieceTokenizer
 
         return T5SentencePieceTokenizer.from_pretrained(name_or_path)
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001 — fall through to the strict/degraded decision below
         errors.append(f"T5SentencePieceTokenizer: {type(e).__name__}: {e}")
     if strict:
         raise RuntimeError(

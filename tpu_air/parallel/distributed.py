@@ -327,7 +327,7 @@ class ObjectPlane:
                 try:  # cache for later readers on this host
                     self.store.put(value, object_id)
                     self.gcs.publish_object(object_id, self.node_id)
-                except Exception:
+                except Exception:  # noqa: BLE001 — cache write is best-effort; value is in hand
                     pass
                 return value
         raise KeyError(
@@ -452,7 +452,7 @@ def spawn_local_cluster(
 
         gcs_proc, gcs_port = start_gcs(dead_after_ms=3000)
         gcs_address = f"127.0.0.1:{gcs_port}"
-    except Exception as e:
+    except Exception as e:  # noqa: BLE001 — degrade to no control plane (e.g. no protoc)
         print(f"spawn_local_cluster: no gcs ({e})", file=sys.stderr)
 
     # per-cluster random control-plane key (see _authkey): must land in OUR
@@ -515,7 +515,7 @@ def spawn_local_cluster(
             heartbeat = HeartbeatThread(gcs_address, "host-0", interval=0.5,
                                         node_address=f"{host}:{port}")
             heartbeat.start()
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 — liveness is optional; cluster runs without it
             print(f"spawn_local_cluster: host-0 gcs registration failed: {e}",
                   file=sys.stderr)
     ensure_initialized()

@@ -272,6 +272,8 @@ class SemanticSegmentationPredictor(Predictor):
             variables = {"params": self.params}
             if self.batch_stats:
                 variables["batch_stats"] = self.batch_stats
+            # airlint: disable=JX003 — guarded by the None check above: the
+            # lambda is created and jitted once, then memoized on self
             self._jit_forward = jax.jit(
                 lambda x: self.model.apply(variables, x)
             )

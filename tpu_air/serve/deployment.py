@@ -283,7 +283,7 @@ class DeploymentHandle:
 
                 try:
                     kill(replica)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — best-effort kill; replica may already be dead
                     pass
             self._stop.wait(backoff)
             return min(backoff * 2, 10.0)

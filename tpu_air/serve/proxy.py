@@ -174,7 +174,7 @@ def run(
                 _state.server, _state.thread, _state.port = server, thread, port
             old = _state.routes.get(prefix)
             _state.routes[prefix] = handle
-    except Exception:
+    except Exception:  # noqa: BLE001 — ANY failure past replica start must release them
         _retire(handle)  # deployment failed after replicas started
         raise
     if old is not None:
@@ -196,7 +196,7 @@ def _retire(handle: DeploymentHandle) -> None:
     for replica in replicas:
         try:
             kill(replica)
-        except Exception:
+        except Exception:  # noqa: BLE001 — best-effort kill; replica may already be dead
             pass
 
 

@@ -387,7 +387,7 @@ def _distributed_gbdt_loop(config, world, label_column, num_boost_round,
         for key in set(keys):
             try:
                 store.delete(key)
-            except Exception:
+            except Exception:  # noqa: BLE001 — best-effort cleanup; key may already be gone
                 pass
 
     try:

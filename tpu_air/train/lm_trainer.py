@@ -128,7 +128,7 @@ def lm_train_loop(config: Dict[str, Any]) -> None:
         losses, tokens, nsteps = [], 0, 0
         for ids, tgt in batches(train_ds, global_bs):
             params, opt_state, loss = step(params, opt_state, ids, tgt)
-            losses.append(float(loss))
+            losses.append(loss)  # device scalar; host sync deferred to epoch end
             tokens += ids.shape[0] * ids.shape[1]
             nsteps += 1
             if args.max_steps_per_epoch and nsteps >= args.max_steps_per_epoch:
@@ -144,12 +144,13 @@ def lm_train_loop(config: Dict[str, Any]) -> None:
             "mesh_sequence": sp,
         }
         if eval_ds is not None and args.evaluation_strategy == "epoch":
-            tot, cnt = 0.0, 0
             ebs = args.per_device_eval_batch_size * dp
-            for ids, tgt in batches(eval_ds, ebs, drop_last=False):
-                s, c = eval_step(params, ids, tgt)
-                tot += float(s)
-                cnt += int(c)
+            # keep eval results on device across the loop; one host sync
+            # after it preserves async dispatch pipelining (airlint JX004)
+            parts = [eval_step(params, ids, tgt)
+                     for ids, tgt in batches(eval_ds, ebs, drop_last=False)]
+            tot = sum(float(s) for s, _ in parts)  # airlint: disable=JX004 — epoch cadence, not the step path
+            cnt = sum(int(c) for _, c in parts)  # airlint: disable=JX004 — epoch cadence, not the step path
             if cnt:
                 metrics["eval_loss"] = tot / cnt
         ckpt = None
@@ -270,7 +271,7 @@ def _lm_tp_loop(config, args, model_config, preprocessor, mp) -> None:
         losses, tokens, nsteps = [], 0, 0
         for ids, tgt in batches(train_ds, global_bs):
             params, opt_state, loss = train_step(params, opt_state, ids, tgt)
-            losses.append(float(loss))
+            losses.append(loss)  # device scalar; host sync deferred to epoch end
             tokens += ids.shape[0] * ids.shape[1]
             nsteps += 1
             if args.max_steps_per_epoch and nsteps >= args.max_steps_per_epoch:

@@ -63,7 +63,7 @@ class Session:
         for sink in self.sinks:
             try:
                 sink.log(rec, self._iter)
-            except Exception:
+            except Exception:  # noqa: BLE001 — a broken sink must not kill the training loop
                 pass
         if checkpoint is not None:
             self._retain(checkpoint, rec)
@@ -139,7 +139,7 @@ def _default_sinks(run_dir: str) -> List:
         from tpu_air.utils.metrics import TensorboardSink
 
         return [TensorboardSink(run_dir)]
-    except Exception:
+    except Exception:  # noqa: BLE001 — tensorboard missing or broken: run without the sink
         return []
 
 

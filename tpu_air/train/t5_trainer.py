@@ -313,7 +313,7 @@ def t5_train_loop(config: Dict[str, Any]) -> None:
         }
 
         if eval_ds is not None and args.evaluation_strategy == "epoch":
-            tot, cnt = 0.0, 0
+            parts = []  # device scalars; host sync deferred past the loop
             ebs = args.per_device_eval_batch_size * dp
             for batch_df in eval_ds.iter_batches(
                 batch_size=ebs, batch_format="pandas", drop_last=False
@@ -328,9 +328,12 @@ def t5_train_loop(config: Dict[str, Any]) -> None:
                             lambda v: np.full_like(np.asarray(v), pad_id)
                         )
                     batch_df = pd.concat([batch_df, pad_rows], ignore_index=True)
-                loss, ntok = eval_step(params, put_batch(collate(batch_df, keys, seq_len)))
-                tot += float(loss) * int(ntok)
-                cnt += int(ntok)
+                parts.append(
+                    eval_step(params, put_batch(collate(batch_df, keys, seq_len)))
+                )
+            # one post-loop sync keeps eval dispatch pipelined (airlint JX004)
+            tot = sum(float(loss) * int(ntok) for loss, ntok in parts)  # airlint: disable=JX004 — epoch cadence, not the step path
+            cnt = sum(int(ntok) for _, ntok in parts)  # airlint: disable=JX004 — epoch cadence, not the step path
             metrics["eval_loss"] = tot / max(cnt, 1)
 
         ckpt = None

@@ -43,7 +43,7 @@ class TensorboardSink:
         try:
             if self.writer is not None:
                 self.writer.close()
-        except Exception:
+        except Exception:  # noqa: BLE001 — close is best-effort on a possibly-dead writer
             pass
 
 
