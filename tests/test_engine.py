@@ -275,6 +275,71 @@ def test_metrics_and_dashboard_export(lm):
     assert "engine-test-metrics" not in engine_stats()  # unregistered
 
 
+def test_engine_emits_connected_trace(lm):
+    """A traced request through the engine yields a connected span tree at
+    retirement: engine.request → queue_wait / prefill / decode, parented
+    under the submitter's span, annotated with slot + occupancy."""
+    cfg, model, params = lm
+    from tpu_air.observability import tracing
+
+    tracing.enable()
+    tracing.recorder().clear()
+    try:
+        engine = InferenceEngine(
+            model, params,
+            EngineConfig(num_slots=2, slot_len=64, max_new_tokens=4),
+            auto_start=False, name="engine-test-trace",
+        )
+        with tracing.span("client.generate") as root:
+            engine.generate(_prompts(seed=13, n=2))
+        engine.close()
+        spans = tracing.recorder().for_trace(root.trace_id)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s.name, []).append(s)
+        assert len(by_name.get("engine.request", [])) == 2
+        assert len(by_name.get("engine.queue_wait", [])) == 2
+        assert len(by_name.get("engine.prefill", [])) == 2
+        assert len(by_name.get("engine.decode", [])) == 2
+        req_span = by_name["engine.request"][0]
+        assert req_span.parent_id == root.span_id
+        req_ids = {s.span_id for s in by_name["engine.request"]}
+        for child_name in ("engine.queue_wait", "engine.prefill", "engine.decode"):
+            for child in by_name[child_name]:
+                assert child.parent_id in req_ids
+        for pf in by_name["engine.prefill"]:
+            assert "slot" in pf.attrs and "bucket" in pf.attrs
+            assert pf.attrs["prompt_len"] > 0
+        for dc in by_name["engine.decode"]:
+            assert dc.attrs["tokens"] == 4  # max_new_tokens
+            assert 0 <= dc.attrs["slot"] < 2
+            assert dc.attrs["occupancy"] >= 1
+        # timeline ordering within one request
+        assert req_span.start_ns <= by_name["engine.prefill"][0].start_ns
+        assert by_name["engine.decode"][0].end_ns <= req_span.end_ns
+    finally:
+        tracing.disable()
+        tracing.recorder().clear()
+
+
+def test_engine_untraced_requests_cost_nothing(lm):
+    """With tracing off, requests carry zero-valued stamps and the recorder
+    stays empty (the zero-cost-when-off contract)."""
+    cfg, model, params = lm
+    from tpu_air.observability import tracing
+
+    assert not tracing.enabled()
+    tracing.recorder().clear()
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=2, slot_len=64, max_new_tokens=3),
+        auto_start=False, name="engine-test-notrace",
+    )
+    engine.generate(_prompts(seed=14, n=2))
+    engine.close()
+    assert len(tracing.recorder()) == 0
+
+
 # ---------------------------------------------------------------------------
 # T5 continuous-decode entry points
 # ---------------------------------------------------------------------------

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""trace_dump — pull airtrace spans off a running dashboard (or the local
+recorder) and write chrome://tracing-loadable JSON.
+
+Usage::
+
+    # list recent traces on a live dashboard
+    python tools/trace_dump.py --url http://127.0.0.1:8265 --list
+
+    # export everything (or one trace) to a file for chrome://tracing /
+    # ui.perfetto.dev
+    python tools/trace_dump.py --url http://127.0.0.1:8265 -o trace.json
+    python tools/trace_dump.py --url http://127.0.0.1:8265 \
+        --trace-id 0af7651916cd43dd8448eb211c80319c -o one_request.json
+
+    # no dashboard: dump THIS process's recorder (mostly for scripts that
+    # import tpu_air, enable tracing, run work, then exec this file)
+    python tools/trace_dump.py --local -o trace.json
+
+See docs/OBSERVABILITY.md for the export workflow.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fetch(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="http://127.0.0.1:8265",
+                    help="dashboard base URL (default %(default)s)")
+    ap.add_argument("--trace-id", default=None,
+                    help="export only this trace (32-hex id)")
+    ap.add_argument("--list", action="store_true",
+                    help="print recent trace summaries instead of exporting")
+    ap.add_argument("--local", action="store_true",
+                    help="dump this process's recorder, no dashboard needed")
+    ap.add_argument("-o", "--output", default="trace.json",
+                    help="output file for the chrome-trace JSON")
+    args = ap.parse_args(argv)
+
+    if args.local:
+        from tpu_air.observability import trace_export, tracing
+
+        if args.list:
+            for t in tracing.trace_summaries():
+                print(f"{t['trace_id']}  {t['root']:<30} "
+                      f"{t['spans']:>4} spans  {t['duration_ms']:.2f} ms")
+            return 0
+        n = trace_export.export_file(args.output, trace_id=args.trace_id)
+        print(f"wrote {n} spans to {args.output}")
+        return 0
+
+    base = args.url.rstrip("/")
+    if args.list:
+        payload = _fetch(f"{base}/api/traces")
+        if not payload.get("enabled"):
+            print("tracing is disabled on the target "
+                  "(set TPU_AIR_TRACE=1 or call tracing.enable())",
+                  file=sys.stderr)
+        for t in payload.get("traces", []):
+            print(f"{t['trace_id']}  {t['root']:<30} "
+                  f"{t['spans']:>4} spans  {t['duration_ms']:.2f} ms"
+                  + (f"  [{t['errors']} errors]" if t.get("errors") else ""))
+        return 0
+
+    url = f"{base}/api/traces/export"
+    if args.trace_id:
+        url += f"?trace_id={args.trace_id}"
+    doc = _fetch(url)
+    with open(args.output, "w") as f:
+        json.dump(doc, f)
+    n = doc.get("otherData", {}).get("spans", 0)
+    print(f"wrote {n} spans to {args.output} "
+          "(load in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional
 from . import runtime as rt
 from . import serialization
 from .object_store import ObjectRef, new_object_id
+from tpu_air.observability import tracing as _tracing
 
 
 def _normalize_resources(
@@ -45,6 +46,9 @@ def _pack_payload_local(store, payload_tuple):
 
 
 def _submit_task(fn, args, kwargs, resources) -> ObjectRef:
+    # capture the ambient span context at the submit call-site — None when
+    # tracing is off or no span is active, so the common path ships nothing
+    trace_ctx = _tracing.current_propagation()
     ctx = rt.current_worker()
     if ctx is not None:
         task_id = new_object_id()
@@ -57,14 +61,17 @@ def _submit_task(fn, args, kwargs, resources) -> ObjectRef:
                     "payload": payload,
                     "payload_ref": payload_ref,
                     "resources": resources,
+                    "trace_ctx": trace_ctx,
                 },
             )
         )
         return ObjectRef(task_id)
-    return rt.get_runtime().submit_task(fn, list(args), kwargs, resources)
+    return rt.get_runtime().submit_task(fn, list(args), kwargs, resources,
+                                        trace_ctx=trace_ctx)
 
 
 def _create_actor(cls, args, kwargs, resources, name=None) -> "ActorHandle":
+    trace_ctx = _tracing.current_propagation()
     ctx = rt.current_worker()
     if ctx is not None:
         actor_id = new_object_id()
@@ -80,16 +87,19 @@ def _create_actor(cls, args, kwargs, resources, name=None) -> "ActorHandle":
                     "payload_ref": payload_ref,
                     "resources": resources,
                     "name": name,
+                    "trace_ctx": trace_ctx,
                 },
             )
         )
         return ActorHandle(actor_id, cls.__name__, ObjectRef(ready_id))
     r = rt.get_runtime()
-    actor_id, ready_ref = r.create_actor(cls, list(args), kwargs, resources, name=name)
+    actor_id, ready_ref = r.create_actor(cls, list(args), kwargs, resources,
+                                         name=name, trace_ctx=trace_ctx)
     return ActorHandle(actor_id, cls.__name__, ready_ref)
 
 
 def _submit_actor_task(actor_id, method, args, kwargs) -> ObjectRef:
+    trace_ctx = _tracing.current_propagation()
     ctx = rt.current_worker()
     if ctx is not None:
         task_id = new_object_id()
@@ -105,11 +115,13 @@ def _submit_actor_task(actor_id, method, args, kwargs) -> ObjectRef:
                     "kind": "actor_task",
                     "actor_id": actor_id,
                     "method": method,
+                    "trace_ctx": trace_ctx,
                 },
             )
         )
         return ObjectRef(task_id)
-    return rt.get_runtime().submit_actor_task(actor_id, method, list(args), kwargs)
+    return rt.get_runtime().submit_actor_task(actor_id, method, list(args), kwargs,
+                                              trace_ctx=trace_ctx)
 
 
 class RemoteFunction:

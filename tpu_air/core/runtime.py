@@ -43,6 +43,10 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import serialization
 from .object_store import ObjectRef, ObjectStore, new_object_id
 
+# airtrace propagation (stdlib-only module; the observability package pulls
+# in nothing heavy at import time)
+from tpu_air.observability import tracing as _tracing
+
 # --------------------------------------------------------------------------
 # errors
 # --------------------------------------------------------------------------
@@ -53,12 +57,16 @@ class TpuAirError(Exception):
 
 
 class RemoteError(TpuAirError):
-    """A task/actor method raised; carries the remote traceback."""
+    """A task/actor method raised; carries the remote traceback and, when
+    the failed call was traced, the trace id (``/api/traces?trace_id=...``
+    answers "which hop killed this request")."""
 
-    def __init__(self, cause_repr: str, remote_traceback: str):
+    def __init__(self, cause_repr: str, remote_traceback: str,
+                 trace_id: Optional[str] = None):
         super().__init__(f"{cause_repr}\n\n--- remote traceback ---\n{remote_traceback}")
         self.cause_repr = cause_repr
         self.remote_traceback = remote_traceback
+        self.trace_id = trace_id
 
 
 class ActorDiedError(TpuAirError):
@@ -68,12 +76,14 @@ class ActorDiedError(TpuAirError):
 class _ErrorSentinel:
     """Stored in the object store in place of a result when a task fails."""
 
-    def __init__(self, cause_repr: str, tb: str):
+    def __init__(self, cause_repr: str, tb: str, trace_id: Optional[str] = None):
         self.cause_repr = cause_repr
         self.tb = tb
+        self.trace_id = trace_id
 
     def raise_(self):
-        raise RemoteError(self.cause_repr, self.tb)
+        raise RemoteError(self.cause_repr, self.tb,
+                          trace_id=getattr(self, "trace_id", None))
 
 
 def _resolve_if_error(value):
@@ -99,6 +109,9 @@ class _TaskSpec:
     actor_id: Optional[str] = None
     method: Optional[str] = None
     from_worker: bool = False
+    # airtrace carrier captured at submit time (None unless the submitting
+    # thread had tracing on and an active span — the zero-cost-off default)
+    trace_ctx: Optional[Dict[str, str]] = None
 
 
 @dataclass
@@ -153,8 +166,24 @@ def _store_result(store: ObjectStore, object_id: str, fn, args, kwargs):
         store.put(result, object_id)
         return True
     except BaseException as e:  # noqa: BLE001 - remote boundary
-        store.put(_ErrorSentinel(repr(e), traceback.format_exc()), object_id)
+        store.put(
+            _ErrorSentinel(repr(e), traceback.format_exc(),
+                           trace_id=_tracing.current_trace_id()),
+            object_id,
+        )
         return False
+
+
+def _send_done(worker_id: int, task_id: str) -> None:
+    """Send the task-complete control message, piggybacking any spans this
+    worker recorded since the last done (engine spans, nested task spans) so
+    the driver's recorder sees one merged timeline.  The common untraced
+    case ships the plain 3-tuple."""
+    spans = _tracing.drain_if_any()
+    if spans is None:
+        _worker_ctx.send(("done", worker_id, task_id))
+    else:
+        _worker_ctx.send(("done", worker_id, task_id, spans))
 
 
 def _load_payload(store: ObjectStore, spec: dict):
@@ -191,6 +220,9 @@ def _worker_main(
         for k in list(os.environ):
             if k not in driver_env:
                 os.environ.pop(k, None)
+    # the tracing flag was read at import time, which for forkserver
+    # children predates the env application above — re-read it
+    _tracing._sync_from_env()
     store = ObjectStore(store_root)
     _worker_ctx = _WorkerContext(conn, store, worker_id)
     actors: Dict[str, Any] = {}
@@ -210,10 +242,13 @@ def _worker_main(
                 args, kwargs = _resolve_args(store, args, kwargs)
             except RemoteError as e:
                 store.put(_ErrorSentinel(repr(e), e.remote_traceback), spec["task_id"])
-                _worker_ctx.send(("done", worker_id, spec["task_id"]))
+                _send_done(worker_id, spec["task_id"])
                 continue
-            _store_result(store, spec["task_id"], fn, args, kwargs)
-            _worker_ctx.send(("done", worker_id, spec["task_id"]))
+            name = getattr(fn, "__name__", None) or "task"
+            with _tracing.task_span(f"task.{name}", spec.get("trace_ctx")) as sp:
+                if not _store_result(store, spec["task_id"], fn, args, kwargs):
+                    sp.set_status("error")
+            _send_done(worker_id, spec["task_id"])
         elif kind == "actor_create":
             chip_ids = spec.get("chip_ids") or []
             if chip_ids:
@@ -227,14 +262,18 @@ def _worker_main(
                 os.environ.pop("TPU_AIR_CHIP_IDS", None)
             cls, args, kwargs = _load_payload(store, spec)
             args, kwargs = _resolve_args(store, args, kwargs)
-            _store_result(store, spec["task_id"], cls, args, kwargs)
+            cname = getattr(cls, "__name__", None) or "actor"
+            with _tracing.task_span(f"actor.{cname}.__init__",
+                                    spec.get("trace_ctx")) as sp:
+                if not _store_result(store, spec["task_id"], cls, args, kwargs):
+                    sp.set_status("error")
             # fetch back so a failed __init__ is visible to callers
             inst = store.get(spec["task_id"])
             if isinstance(inst, _ErrorSentinel):
                 failed_actors[spec["actor_id"]] = inst
             else:
                 actors[spec["actor_id"]] = inst
-            _worker_ctx.send(("done", worker_id, spec["task_id"]))
+            _send_done(worker_id, spec["task_id"])
         elif kind == "actor_task":
             inst = actors.get(spec["actor_id"])
             _, args, kwargs = _load_payload(store, spec)
@@ -252,10 +291,13 @@ def _worker_main(
                     method = getattr(inst, spec["method"])
                 except RemoteError as e:
                     store.put(_ErrorSentinel(repr(e), e.remote_traceback), spec["task_id"])
-                    _worker_ctx.send(("done", worker_id, spec["task_id"]))
+                    _send_done(worker_id, spec["task_id"])
                     continue
-                _store_result(store, spec["task_id"], method, args, kwargs)
-            _worker_ctx.send(("done", worker_id, spec["task_id"]))
+                name = f"actor.{type(inst).__name__}.{spec['method']}"
+                with _tracing.task_span(name, spec.get("trace_ctx")) as sp:
+                    if not _store_result(store, spec["task_id"], method, args, kwargs):
+                        sp.set_status("error")
+            _send_done(worker_id, spec["task_id"])
 
 
 # --------------------------------------------------------------------------
@@ -367,6 +409,9 @@ class Runtime:
         self.named_actors: Dict[str, str] = {}
         self.task_resources: Dict[str, Dict[str, float]] = {}
         self.task_worker: Dict[str, int] = {}
+        # task_id -> trace id, for traced tasks only: lets worker-death
+        # sentinels carry the trace id of the request they killed
+        self.task_trace: Dict[str, str] = {}
         self.queue: List[_TaskSpec] = []
         # Actor creations wait in their own FIFO queue for resources (chip
         # leases especially) instead of spin-waiting in the caller — an
@@ -570,10 +615,16 @@ class Runtime:
     def _handle_msg(self, worker: _WorkerState, msg):
         kind = msg[0]
         if kind == "done":
-            _, wid, task_id = msg
+            _, wid, task_id = msg[:3]
+            # traced tasks piggyback their worker-side spans on the done
+            # message; fold them into the driver recorder so /api/traces
+            # serves one merged timeline
+            if len(msg) > 3 and msg[3]:
+                _tracing.recorder().record_many(msg[3])
             with self.lock:
                 res = self.task_resources.pop(task_id, None)
                 self.task_worker.pop(task_id, None)
+                self.task_trace.pop(task_id, None)
                 if res:
                     self._release(res)
                 if worker.busy_task == task_id:
@@ -586,6 +637,9 @@ class Runtime:
         elif kind == "submit":
             spec = _TaskSpec(**msg[1])
             spec.from_worker = True
+            if spec.trace_ctx:
+                with self.lock:
+                    self.task_trace[spec.task_id] = spec.trace_ctx["trace_id"]
             self._enqueue(spec)
         elif kind == "create_actor":
             # Non-blocking: the creation queues for resources in _schedule.
@@ -593,6 +647,9 @@ class Runtime:
         elif kind == "actor_call":
             spec = _TaskSpec(**msg[1])
             spec.from_worker = True
+            if spec.trace_ctx:
+                with self.lock:
+                    self.task_trace[spec.task_id] = spec.trace_ctx["trace_id"]
             self._submit_actor_task_spec(spec)
         elif kind == "kill_actor":
             self.kill_actor(msg[1], no_restart=True)
@@ -613,6 +670,7 @@ class Runtime:
                         _ErrorSentinel(
                             f"WorkerCrashed(worker={worker.worker_id})",
                             "worker process died while executing this task",
+                            trace_id=self.task_trace.pop(task_id, None),
                         ),
                         task_id,
                     )
@@ -822,11 +880,16 @@ class Runtime:
         ref = self.store.put(blob)
         return None, ref.id
 
-    def submit_task(self, fn, args, kwargs, resources: Dict[str, float]) -> ObjectRef:
+    def submit_task(self, fn, args, kwargs, resources: Dict[str, float],
+                    trace_ctx: Optional[Dict[str, str]] = None) -> ObjectRef:
         self._check_satisfiable(resources)
         task_id = new_object_id()
         payload, payload_ref = self._pack_payload((fn, args, kwargs))
-        spec = _TaskSpec(task_id, payload, payload_ref, resources)
+        spec = _TaskSpec(task_id, payload, payload_ref, resources,
+                         trace_ctx=trace_ctx)
+        if trace_ctx:
+            with self.lock:
+                self.task_trace[task_id] = trace_ctx["trace_id"]
         self._enqueue(spec)
         return ObjectRef(task_id)
 
@@ -885,6 +948,7 @@ class Runtime:
                             "task_id": spec.task_id,
                             "payload": spec.payload,
                             "payload_ref": spec.payload_ref,
+                            "trace_ctx": spec.trace_ctx,
                         },
                     )
                 )
@@ -925,6 +989,7 @@ class Runtime:
         kwargs,
         resources: Dict[str, float],
         name: Optional[str] = None,
+        trace_ctx: Optional[Dict[str, str]] = None,
     ) -> Tuple[str, ObjectRef]:
         actor_id = new_object_id()
         ready_id = new_object_id()
@@ -936,6 +1001,7 @@ class Runtime:
             payload_ref=payload_ref,
             resources=resources,
             name=name,
+            trace_ctx=trace_ctx,
         )
         return actor_id, ObjectRef(ready_id)
 
@@ -948,6 +1014,7 @@ class Runtime:
         resources: Dict[str, float],
         name: Optional[str],
         from_worker: bool = False,
+        trace_ctx: Optional[Dict[str, str]] = None,
     ):
         try:
             self._check_satisfiable(resources)
@@ -972,7 +1039,11 @@ class Runtime:
             "payload_ref": payload_ref,
             "resources": resources,
             "name": name,
+            "trace_ctx": trace_ctx,
         }
+        if trace_ctx:
+            with self.lock:
+                self.task_trace[ready_id] = trace_ctx["trace_id"]
         with self.lock:
             self.actor_queue.append(rec)
             self.pending_actors[actor_id] = rec
@@ -1088,6 +1159,7 @@ class Runtime:
                             "payload_ref": rec["payload_ref"],
                             "actor_id": actor_id,
                             "chip_ids": chip_ids,
+                            "trace_ctx": rec.get("trace_ctx"),
                         },
                     )
                 )
@@ -1108,6 +1180,7 @@ class Runtime:
                                 "payload_ref": spec.payload_ref,
                                 "actor_id": spec.actor_id,
                                 "method": spec.method,
+                                "trace_ctx": spec.trace_ctx,
                             },
                         )
                     )
@@ -1117,13 +1190,17 @@ class Runtime:
             self._gcs("register_actor", actor_id, node_id=self.node_id,
                       name=rec["name"] or "", chip_ids=list(chip_ids))
 
-    def submit_actor_task(self, actor_id, method, args, kwargs) -> ObjectRef:
+    def submit_actor_task(self, actor_id, method, args, kwargs,
+                          trace_ctx: Optional[Dict[str, str]] = None) -> ObjectRef:
         task_id = new_object_id()
         payload, payload_ref = self._pack_payload((None, args, kwargs))
         spec = _TaskSpec(
             task_id, payload, payload_ref, {}, kind="actor_task",
-            actor_id=actor_id, method=method,
+            actor_id=actor_id, method=method, trace_ctx=trace_ctx,
         )
+        if trace_ctx:
+            with self.lock:
+                self.task_trace[task_id] = trace_ctx["trace_id"]
         self._submit_actor_task_spec(spec)
         return ObjectRef(task_id)
 
@@ -1136,7 +1213,10 @@ class Runtime:
             st = self.actors.get(spec.actor_id)
             if st is None or st.dead or not st.worker.alive:
                 self.store.put(
-                    _ErrorSentinel(f"ActorDiedError(actor={spec.actor_id})", ""),
+                    _ErrorSentinel(
+                        f"ActorDiedError(actor={spec.actor_id})", "",
+                        trace_id=(spec.trace_ctx or {}).get("trace_id"),
+                    ),
                     spec.task_id,
                 )
                 self._notify_objects()
@@ -1154,6 +1234,7 @@ class Runtime:
                             "payload_ref": spec.payload_ref,
                             "actor_id": spec.actor_id,
                             "method": spec.method,
+                            "trace_ctx": spec.trace_ctx,
                         },
                     )
                 )
@@ -1170,6 +1251,7 @@ class Runtime:
                     _ErrorSentinel(
                         f"ActorDiedError(actor={spec.actor_id})",
                         "worker pipe broken at submit",
+                        trace_id=(spec.trace_ctx or {}).get("trace_id"),
                     ),
                     spec.task_id,
                 )

@@ -43,6 +43,8 @@ from tpu_air.models.lm.generate import (
     make_lm_prefill_fn,
 )
 
+from tpu_air.observability import tracing as _tracing
+
 from .metrics import EngineMetrics, unregister
 from .scheduler import Scheduler
 from .slots import Slot, SlotManager, make_insert_fn
@@ -188,6 +190,8 @@ class InferenceEngine:
         self.cache = self._insert(self.cache, segment, slot.index)
         first = int(tok[0])
         req.first_token_at = time.monotonic()
+        if req.t_submit_ns:  # traced request: stamp TTFT for span emission
+            req.t_first_ns = _tracing.now_ns()
         self.metrics.record_ttft(req.first_token_at - req.submitted_at)
         req.stream._emit(first)
         self.metrics.record_tokens(1)  # prefill's first token
@@ -227,11 +231,58 @@ class InferenceEngine:
         self.metrics.record_step(dt, emitted)
 
     def _retire(self, slot: Slot) -> None:
+        if slot.request.t_submit_ns:
+            self._emit_request_spans(slot)
         slot.request.stream._finish()
         self.metrics.record_complete()
         self.slots.release(slot)
         self._cur_tok[slot.index] = 0
         self._pos[slot.index] = 0
+
+    def _emit_request_spans(self, slot: Slot) -> None:
+        """Retirement-time airtrace emission: the request's whole span tree
+        (queue-wait → prefill → decode residency) is reconstructed here from
+        the wall-clock stamps collected along the way, so the decode hot
+        loop does zero tracing work (and stays JX004-clean)."""
+        req = slot.request
+        end = _tracing.now_ns()
+        ctx = req.trace_ctx or {}
+        root = _tracing.record_span(
+            "engine.request",
+            trace_id=ctx.get("trace_id"),
+            parent_id=ctx.get("span_id"),
+            start_ns=req.t_submit_ns,
+            end_ns=end,
+            attrs={"engine": self.name, "request_id": req.request_id},
+        )
+        if req.t_admit_ns:
+            _tracing.record_span(
+                "engine.queue_wait",
+                trace_id=root.trace_id, parent_id=root.span_id,
+                start_ns=req.t_submit_ns, end_ns=req.t_admit_ns,
+            )
+        if req.t_admit_ns and req.t_first_ns:
+            _tracing.record_span(
+                "engine.prefill",
+                trace_id=root.trace_id, parent_id=root.span_id,
+                start_ns=req.t_admit_ns, end_ns=req.t_first_ns,
+                attrs={
+                    "slot": slot.index,
+                    "prompt_len": len(req.prompt),
+                    "bucket": self.config.bucket_for(len(req.prompt)),
+                },
+            )
+        if req.t_first_ns:
+            _tracing.record_span(
+                "engine.decode",
+                trace_id=root.trace_id, parent_id=root.span_id,
+                start_ns=req.t_first_ns, end_ns=end,
+                attrs={
+                    "slot": slot.index,
+                    "tokens": slot.pos - len(req.prompt) + 1,
+                    "occupancy": self.slots.occupancy(),
+                },
+            )
 
     # -- background loop / lifecycle -----------------------------------------
     def start(self) -> None:

@@ -158,19 +158,31 @@ def snapshot_all() -> Dict[str, Dict[str, Any]]:
     return {m.name: m.snapshot() for m in engines}
 
 
-def prometheus_lines() -> list:
-    """Engine gauges in prometheus text form (dashboard /metrics)."""
+def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
+    """Engine gauges in prometheus text form (dashboard /metrics).
+
+    ``snapshots`` defaults to this process's registry; the dashboard passes
+    a merged dict that also folds in serve-replica snapshots (keys there are
+    ``deployment/replica/engine`` paths — label values, so any charset is
+    fine after quote-escaping)."""
+    if snapshots is None:
+        snapshots = snapshot_all()
     lines = []
-    for name, snap in sorted(snapshot_all().items()):
-        tag = f'{{engine="{name}"}}'
+    for name, snap in sorted(snapshots.items()):
+        if not snap:
+            continue
+        label = name.replace("\\", "\\\\").replace('"', '\\"')
+        tag = f'{{engine="{label}"}}'
         for key in ("queue_depth", "slot_occupancy", "requests_submitted",
                     "requests_rejected", "requests_completed",
                     "tokens_emitted"):
-            lines.append(f"tpu_air_engine_{key}{tag} {snap[key]}")
-        lines.append(f"tpu_air_engine_tokens_per_s{tag} "
-                     f"{snap['tokens_per_s']:.3f}")
+            if key in snap:
+                lines.append(f"tpu_air_engine_{key}{tag} {snap[key]}")
+        if "tokens_per_s" in snap:
+            lines.append(f"tpu_air_engine_tokens_per_s{tag} "
+                         f"{snap['tokens_per_s']:.3f}")
         for dist_key in ("ttft_s", "step_latency_s"):
-            d = snap[dist_key]
+            d = snap.get(dist_key) or {}
             if d.get("count"):
                 lines.append(
                     f"tpu_air_engine_{dist_key}_p50{tag} {d['p50']:.6f}"

@@ -15,6 +15,8 @@ import threading
 from collections import deque
 from typing import Deque, List
 
+from tpu_air.observability import tracing as _tracing
+
 from .types import EngineConfig, EngineOverloadedError, Request
 
 
@@ -31,6 +33,11 @@ class Scheduler:
     def submit(self, request: Request) -> None:
         """Enqueue; raises :class:`EngineOverloadedError` when the queue is
         at ``max_queue`` (backpressure — the caller sees 503, retries)."""
+        if _tracing.enabled():
+            # stamp outside the lock: carrier + submit time feed the
+            # retirement-time span emission (engine._emit_request_spans)
+            request.trace_ctx = _tracing.current_propagation()
+            request.t_submit_ns = _tracing.now_ns()
         with self._lock:
             if len(self._queue) >= self.config.max_queue:
                 raise EngineOverloadedError(
@@ -49,6 +56,11 @@ class Scheduler:
                 out.append(self._queue.popleft())
             if not self._queue:
                 self._work.clear()
+        if _tracing.enabled() and out:
+            t = _tracing.now_ns()
+            for r in out:
+                if r.t_submit_ns:
+                    r.t_admit_ns = t
         return out
 
     def depth(self) -> int:

@@ -143,3 +143,10 @@ class Request:
     stream: ResponseStream
     submitted_at: float = field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
+    # airtrace: carrier captured at submit + wall-clock stamps (ns) for the
+    # retirement-time span emission (engine.py _emit_request_spans).  All
+    # zero/None when tracing is off — the hot loop never touches them.
+    trace_ctx: Optional[dict] = None
+    t_submit_ns: int = 0
+    t_admit_ns: int = 0
+    t_first_ns: int = 0

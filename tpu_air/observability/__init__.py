@@ -9,6 +9,8 @@ endpoint over the driver runtime's live state (SURVEY.md §2B dashboard row,
 
 from .dashboard import start_dashboard, stop_dashboard, snapshot
 from .profiler import profile_trace, step_timer
+from . import tracing
+from . import trace_export
 
 __all__ = [
     "profile_trace",
@@ -16,4 +18,6 @@ __all__ = [
     "start_dashboard",
     "step_timer",
     "stop_dashboard",
+    "trace_export",
+    "tracing",
 ]

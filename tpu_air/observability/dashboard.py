@@ -5,7 +5,10 @@ Install_locally.md:64-67):
   /                 tiny HTML overview
   /api/cluster      resources, workers, actors, queue depth
   /api/objects      object-store + arena stats
-  /api/engines      per-engine gauges (queue depth, occupancy, tokens/s, TTFT)
+  /api/engines      per-engine gauges (queue depth, occupancy, tokens/s, TTFT),
+                    driver-local engines merged with serve-replica snapshots
+  /api/traces       recent trace summaries; ?trace_id=... for one trace's spans
+  /api/traces/export  chrome://tracing-loadable JSON (docs/OBSERVABILITY.md)
   /api/version      framework version
   /metrics          prometheus text exposition of the cluster + engine gauges
 """
@@ -91,14 +94,45 @@ def object_stats() -> Dict[str, Any]:
 
 
 def engine_stats() -> Dict[str, Any]:
-    """Per-engine gauge snapshots (the /api/engines payload).  Engines in
-    THIS process only — a driver-embedded engine or the bench/test harness;
-    serve replica engines report through the deployment's ``stats`` method."""
+    """Per-engine gauge snapshots (the /api/engines payload): driver-local
+    engines (bench/test harness, driver-embedded) merged with serve-replica
+    engines scraped over the deployment handles' ``engine_stats`` RPC
+    (replica keys: ``deployment/replica-idx/engine-name``)."""
+    out: Dict[str, Any] = {}
     try:
         from tpu_air.engine.metrics import snapshot_all
     except Exception:  # noqa: BLE001 — engine package optional (no jax)
-        return {}
-    return snapshot_all()
+        pass
+    else:
+        out.update(snapshot_all())
+    try:
+        from tpu_air.serve.proxy import replica_engine_stats
+    except Exception:  # noqa: BLE001 — serve package optional
+        pass
+    else:
+        out.update(replica_engine_stats())
+    return out
+
+
+def trace_payload(query: Dict[str, Any]) -> Dict[str, Any]:
+    """The /api/traces payload: recorder stats + recent trace summaries, or
+    one trace's full span list when ``?trace_id=...`` is given."""
+    from . import tracing
+
+    trace_id = (query.get("trace_id") or [None])[0]
+    rec = tracing.recorder()
+    if trace_id:
+        return {
+            "enabled": tracing.enabled(),
+            "trace_id": trace_id,
+            "spans": [s.to_dict() for s in rec.for_trace(trace_id)],
+        }
+    limit = int((query.get("limit") or [64])[0])
+    return {
+        "enabled": tracing.enabled(),
+        "recorder": rec.stats(),
+        "traces": tracing.trace_summaries(limit),
+    }
 
 
 def _prometheus_text() -> str:
@@ -118,17 +152,24 @@ def _prometheus_text() -> str:
         lines.append(f"tpu_air_store_file_objects {ost.get('file_objects', 0)}")
         lines.append(f"tpu_air_store_file_bytes {ost.get('file_bytes', 0)}")
         if "arena" in ost:
+            from tpu_air.utils.metrics import sanitize_metric_name
+
             for k, v in ost["arena"].items():
-                lines.append(f"tpu_air_arena_{k} {v}")
+                # arena stat keys are free-form (may carry dots/dashes);
+                # they must still land as valid prometheus identifiers
+                lines.append(f"tpu_air_arena_{sanitize_metric_name(k)} {v}")
     # engine gauges live OUTSIDE the initialized check: an engine embedded
     # in this process (tests, bench, notebook) exports metrics even when the
-    # cluster runtime was never brought up
-    try:
-        from tpu_air.engine.metrics import prometheus_lines
-    except Exception:  # noqa: BLE001 — engine package optional (no jax)
-        pass
-    else:
-        lines += prometheus_lines()
+    # cluster runtime was never brought up.  engine_stats() also folds in
+    # serve-replica snapshots, so /metrics covers both.
+    snapshots = engine_stats()
+    if snapshots:
+        try:
+            from tpu_air.engine.metrics import prometheus_lines
+        except Exception:  # noqa: BLE001 — engine package optional (no jax)
+            pass
+        else:
+            lines += prometheus_lines(snapshots)
     return "\n".join(lines) + "\n"
 
 
@@ -137,6 +178,8 @@ _INDEX_HTML = """<!doctype html><html><head><title>tpu_air dashboard</title></he
 <p>JSON endpoints: <a href="/api/cluster">/api/cluster</a> ·
 <a href="/api/objects">/api/objects</a> ·
 <a href="/api/engines">/api/engines</a> ·
+<a href="/api/traces">/api/traces</a> ·
+<a href="/api/traces/export">/api/traces/export</a> ·
 <a href="/api/version">/api/version</a> ·
 <a href="/metrics">/metrics</a></p>
 <pre id="s"></pre>
@@ -161,7 +204,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
-        path = self.path.split("?")[0].rstrip("/") or "/"
+        from urllib.parse import parse_qs, urlsplit
+
+        parts = urlsplit(self.path)
+        path = parts.path.rstrip("/") or "/"
+        query = parse_qs(parts.query)
         try:
             if path == "/":
                 self._send(200, _INDEX_HTML.encode(), "text/html")
@@ -171,6 +218,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(object_stats()).encode(), "application/json")
             elif path == "/api/engines":
                 self._send(200, json.dumps(engine_stats()).encode(), "application/json")
+            elif path == "/api/traces":
+                self._send(200, json.dumps(trace_payload(query)).encode(),
+                           "application/json")
+            elif path == "/api/traces/export":
+                from . import trace_export
+
+                trace_id = (query.get("trace_id") or [None])[0]
+                self._send(
+                    200,
+                    trace_export.export_json(trace_id=trace_id).encode(),
+                    "application/json",
+                )
             elif path == "/api/version":
                 import tpu_air
 

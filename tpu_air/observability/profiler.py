@@ -14,6 +14,8 @@ import contextlib
 import time
 from typing import Any, Dict, Iterator, Optional
 
+from . import tracing as _tracing
+
 
 class step_timer:
     """Accumulates per-step wall times; cheap enough for every train step.
@@ -22,10 +24,16 @@ class step_timer:
     >>> with t.step():  # around each train_step
     ...     ...
     >>> t.summary()  # {'steps': N, 'mean_s': ..., 'p50_s': ..., 'p95_s': ...}
+
+    With ``span_name`` set AND tracing enabled, every step additionally
+    lands as an airtrace span (parented under the ambient context) so the
+    same numbers show up on the request/trial timeline; the default path
+    stays a bare perf_counter delta.
     """
 
-    def __init__(self):
+    def __init__(self, span_name: Optional[str] = None):
         self.durations: list = []
+        self._span_name = span_name
 
     @contextlib.contextmanager
     def step(self) -> Iterator[None]:
@@ -33,7 +41,19 @@ class step_timer:
         try:
             yield
         finally:
-            self.durations.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.durations.append(dt)
+            if self._span_name is not None and _tracing.enabled():
+                end = _tracing.now_ns()
+                ctx = _tracing.current_context()
+                _tracing.record_span(
+                    self._span_name,
+                    trace_id=ctx.trace_id if ctx else None,
+                    parent_id=ctx.span_id if ctx else None,
+                    start_ns=end - int(dt * 1e9),
+                    end_ns=end,
+                    attrs={"step": len(self.durations)},
+                )
 
     def summary(self) -> Dict[str, Any]:
         if not self.durations:
@@ -54,7 +74,11 @@ class step_timer:
 def profile_trace(log_dir: str, host_tracer_level: Optional[int] = None) -> Iterator[None]:
     """JAX xplane trace around a region — open the resulting directory in
     TensorBoard's profile plugin (tensorboardX is in the pinned stack,
-    requirements.txt:156-equivalent)."""
+    requirements.txt:156-equivalent).
+
+    When tracing is enabled, the region also lands as an airtrace span whose
+    ``log_dir`` attr points at the xplane dump — the trace id is the join
+    key between the host-side timeline and the on-chip profile."""
     import jax
 
     opts = {}
@@ -66,5 +90,18 @@ def profile_trace(log_dir: str, host_tracer_level: Optional[int] = None) -> Iter
             opts["profiler_options"] = po
         except AttributeError:  # older jax: legacy kwarg
             opts["host_tracer_level"] = host_tracer_level
-    with jax.profiler.trace(log_dir, **opts):
-        yield
+    t0 = _tracing.now_ns() if _tracing.enabled() else 0
+    try:
+        with jax.profiler.trace(log_dir, **opts):
+            yield
+    finally:
+        if t0:
+            ctx = _tracing.current_context()
+            _tracing.record_span(
+                "profiler.xplane_trace",
+                trace_id=ctx.trace_id if ctx else None,
+                parent_id=ctx.span_id if ctx else None,
+                start_ns=t0,
+                end_ns=_tracing.now_ns(),
+                attrs={"log_dir": log_dir},
+            )

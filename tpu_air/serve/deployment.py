@@ -40,7 +40,10 @@ def _actor_dead(replica) -> bool:
         if st is None:
             # not in the table: dead unless its creation is still queued
             return replica._actor_id not in rt.pending_actors
-        return st.dead or not st.worker.alive
+        # st.worker.alive is the LISTENER's view and lags a kill by one
+        # pipe-EOF detection; /-/healthz right after a replica dies must
+        # not report 200, so ask the process itself (ROADMAP item 3a)
+        return st.dead or not st.worker.alive or not st.worker.proc.is_alive()
 
 
 @dataclass(frozen=True)
@@ -150,6 +153,16 @@ class _Replica:
     def ping(self):
         return "ok"
 
+    def engine_stats(self) -> Dict[str, Any]:
+        """Engine-metrics snapshot from the wrapped object, when it exposes
+        one (``EngineDeployment``'s ``stats``); ``{}`` for plain deployments.
+        The dashboard merges these into ``/api/engines`` and ``/metrics``."""
+        stats = getattr(self._obj, "stats", None)
+        if not callable(stats):
+            return {}
+        out = stats()
+        return out if isinstance(out, dict) else {}
+
 
 class DeploymentHandle:
     """Round-robin handle over a deployment's live replica actors, with
@@ -216,6 +229,25 @@ class DeploymentHandle:
         with self._lock:
             self._replicas = [r for r in self._replicas if not _actor_dead(r)]
             return len(self._replicas)
+
+    def engine_stats(self, timeout: float = 10.0) -> Dict[str, Dict[str, Any]]:
+        """Engine-metrics snapshots from every replica in rotation, keyed
+        ``<deployment>/<replica-idx>/<engine-name>``.  Replicas without an
+        engine (plain deployments, or an EngineDeployment that hasn't built
+        yet) contribute nothing; a dying replica must not fail the scrape."""
+        with self._lock:
+            replicas = list(self._replicas)
+        out: Dict[str, Dict[str, Any]] = {}
+        for i, replica in enumerate(replicas):
+            try:
+                snap = core_api.get(replica.engine_stats.remote(),
+                                    timeout=timeout)
+            except Exception:  # noqa: BLE001 — scrape is best-effort
+                continue
+            if snap:
+                key = f"{self.deployment_name}/{i}/{snap.get('name', 'engine')}"
+                out[key] = snap
+        return out
 
     # -- calls ---------------------------------------------------------------
     def remote(self, *args, **kwargs):

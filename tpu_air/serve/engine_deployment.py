@@ -113,7 +113,11 @@ class _EngineServer:
         return {"tokens": toks[cursor:], "done": done}
 
     def stats(self) -> Dict[str, Any]:
-        return self._ensure_engine().metrics.snapshot()
+        # a dashboard scrape must NEVER force the lazy engine build (model
+        # load + compile) — no engine yet means nothing to report
+        if self._engine is None:
+            return {}
+        return self._engine.metrics.snapshot()
 
 
 EngineDeployment = Deployment(

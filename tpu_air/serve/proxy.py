@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 
 from tpu_air.core import api as core_api
 from tpu_air.core.runtime import RemoteError
+from tpu_air.observability import tracing as _tracing
 
 from .deployment import (
     Application,
@@ -79,10 +80,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # surface the request's trace to the client: curl the trace id
+        # straight into /api/traces?trace_id=... (docs/OBSERVABILITY.md)
+        ctx = _tracing.current_context()
+        if ctx is not None:
+            self.send_header("traceparent", _tracing.format_traceparent(ctx))
+            self.send_header("x-tpu-air-trace-id", ctx.trace_id)
         self.end_headers()
         self.wfile.write(body)
 
     def _dispatch(self):
+        if not _tracing.enabled():
+            self._dispatch_inner()
+            return
+        # root span per HTTP request; an inbound W3C traceparent header
+        # continues the caller's trace instead of rooting a new one
+        parent = _tracing.extract_traceparent(self.headers.get("traceparent"))
+        with _tracing.span(
+            "http.request", parent=parent,
+            attrs={"path": self.path.split("?")[0],
+                   "method": self.command},
+        ) as sp:
+            self._dispatch_inner(sp)
+
+    def _dispatch_inner(self, sp=None):
         from urllib.parse import urlsplit
 
         self.path = urlsplit(self.path).path
@@ -212,6 +233,21 @@ def shutdown() -> None:
             _state.server = None
             _state.thread = None
             _state.port = None
+
+
+def replica_engine_stats() -> Dict[str, Dict[str, Any]]:
+    """Engine-metrics snapshots from every deployed replica, merged across
+    routes — the dashboard folds this into ``/api/engines`` + ``/metrics``
+    so replica-side engines are visible beyond the driver's own registry."""
+    with _state.lock:
+        handles = list(_state.routes.values())
+    out: Dict[str, Dict[str, Any]] = {}
+    for handle in handles:
+        try:
+            out.update(handle.engine_stats())
+        except Exception:  # noqa: BLE001 — scrape is best-effort
+            continue
+    return out
 
 
 def status() -> Dict[str, Any]:

@@ -4,7 +4,20 @@ stream)."""
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict
+
+_INVALID_METRIC_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Map an arbitrary metric key to a valid prometheus identifier:
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``.  Dots, dashes, slashes and anything else
+    outside the charset become ``_``; a leading digit gets a ``_`` prefix."""
+    out = _INVALID_METRIC_CHARS.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
 
 
 class TensorboardSink:
@@ -66,7 +79,7 @@ class PrometheusSink:
                 fv = float(v)
             except (TypeError, ValueError):
                 continue
-            name = k.replace("-", "_").replace("/", "_")
+            name = sanitize_metric_name(k)
             if name not in self.gauges:
                 self.gauges[name] = self._gauge_cls(
                     f"{self.namespace}_{name}", f"tpu_air metric {k}"
