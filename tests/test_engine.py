@@ -308,7 +308,11 @@ def test_engine_emits_connected_trace(lm):
             for child in by_name[child_name]:
                 assert child.parent_id in req_ids
         for pf in by_name["engine.prefill"]:
-            assert "slot" in pf.attrs and "bucket" in pf.attrs
+            # paged default: prefill spans carry the chunk count and
+            # prefix-cache outcome instead of the slab-era bucket
+            assert "slot" in pf.attrs and "chunks" in pf.attrs
+            assert "prefix_hit" in pf.attrs
+            assert pf.attrs["chunks"] >= 1
             assert pf.attrs["prompt_len"] > 0
         for dc in by_name["engine.decode"]:
             assert dc.attrs["tokens"] == 4  # max_new_tokens

@@ -1,0 +1,518 @@
+"""tpu_air.engine.kvpool — the block-table-paged KV pool.
+
+Layers under test:
+  * BlockAllocator: lowest-first alloc, refcounts, free-list reuse, OOM;
+  * PrefixCache: full-chunk + partial-tail matching, insert dedup, LRU
+    leaf eviction;
+  * PagedKVPool: admission plans (chunk work lists, prefix sharing,
+    null-target full cover), copy-on-write resolution, release accounting;
+  * scheduler head-of-line relief: bounded reorder window + counter;
+  * the paged ENGINE: token parity with offline generate AND with the
+    slab engine, prefix hits / CoW end to end, chunked-prefill TTFT
+    flatness under a long-prompt arrival, OOM deferral, kvpool gauges in
+    the metrics snapshot and prometheus text;
+  * the T5 window engine: parity with offline T5 generate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_air.engine import (
+    BlockAllocator,
+    EngineConfig,
+    InferenceEngine,
+    KVPoolOOMError,
+    PagedKVPool,
+    PrefixCache,
+    Request,
+    ResponseStream,
+    Scheduler,
+    T5Engine,
+    T5EngineConfig,
+)
+from tpu_air.engine.kvpool.allocator import NULL_PAGE
+from tpu_air.models.lm import CausalLM, LMConfig
+from tpu_air.models.lm.generate import generate as lm_generate
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = LMConfig.tiny()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def _prompts(seed, n, lo=3, hi=12, vocab=384):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(1, vocab, size=rng.randint(lo, hi))))
+            for _ in range(n)]
+
+
+def _offline(model, params, prompt, max_new, eos=None):
+    out = np.asarray(
+        lm_generate(model, params, [prompt], max_new_tokens=max_new,
+                    eos_token_id=eos)
+    )[0].tolist()
+    if eos is not None and eos in out:
+        out = out[: out.index(eos) + 1]
+    return out
+
+
+def _drain(engine, limit=500):
+    steps = 0
+    while not engine.idle():
+        engine.step()
+        steps += 1
+        assert steps < limit, "engine failed to drain"
+    return steps
+
+
+# ---------------------------------------------------------------------------
+# BlockAllocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_lowest_first_refcounts_and_oom():
+    a = BlockAllocator(num_pages=5, page_len=8)
+    assert a.free_count() == 4 and a.used_count() == 0
+    assert a.refcount(NULL_PAGE) == 1  # pinned forever
+    pages = [a.alloc() for _ in range(4)]
+    assert pages == [1, 2, 3, 4]  # deterministic lowest-first
+    with pytest.raises(KVPoolOOMError):
+        a.alloc()
+    # refcounting: a shared page survives one holder's release
+    a.incref(2)
+    assert a.refcount(2) == 2
+    assert a.decref(2) is False and a.free_count() == 0
+    assert a.decref(2) is True and a.free_count() == 1
+    # freed page is handed out again, lowest-first
+    a.decref(1)
+    assert a.alloc() == 1
+    assert a.alloc() == 2
+
+
+def test_allocator_misuse_is_loud():
+    a = BlockAllocator(num_pages=4, page_len=8)
+    with pytest.raises(ValueError):
+        a.incref(NULL_PAGE)  # null page is not a refcountable target
+    with pytest.raises(ValueError):
+        a.incref(99)
+    with pytest.raises(ValueError):
+        a.incref(1)  # still free
+    with pytest.raises(ValueError):
+        a.decref(1)
+    with pytest.raises(ValueError):
+        BlockAllocator(num_pages=1, page_len=8)
+    with pytest.raises(ValueError):
+        BlockAllocator(num_pages=4, page_len=0)
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache
+# ---------------------------------------------------------------------------
+
+
+def _cached(allocator, cache, tokens):
+    """Simulate a retired request: insert ``tokens``'s full chunks on fresh
+    pages, then drop the slot's own refs so only the cache holds them."""
+    full = len(tokens) // cache.page_len
+    pages = [allocator.alloc() for _ in range(full)]
+    cache.insert(tokens, pages)
+    for p in pages:
+        allocator.decref(p)
+    return pages
+
+
+def test_prefix_match_full_partial_and_miss():
+    a = BlockAllocator(num_pages=16, page_len=4)
+    c = PrefixCache(a, page_len=4)
+    donor = list(range(100, 112))  # 3 full chunks
+    pages = _cached(a, c, donor)
+    assert c.resident_pages() == 3
+
+    m = c.match(donor)
+    assert m.pages == pages and m.matched_tokens == 12 and m.tail_page is None
+    # longer prompt sharing the prefix: full chunks only
+    m = c.match(donor + [7, 7, 7, 7, 7])
+    assert m.pages == pages and m.matched_tokens == 12
+    # partial tail: prompt ends inside a cached chunk -> that page shared
+    m = c.match(donor[:10])
+    assert m.pages == pages[:2]
+    assert m.tail_page == pages[2] and m.matched_tokens == 10
+    # diverging inside a chunk breaks the walk at the chunk boundary
+    m = c.match(donor[:4] + [999] * 8)
+    assert m.pages == pages[:1] and m.matched_tokens == 4
+    m = c.match([999] * 8)
+    assert m.pages == [] and m.matched_tokens == 0
+    assert c.hits == 4 and c.misses == 1 and c.partial_hits == 1
+    # capacity probes (touch=False) must not move stats
+    c.match(donor, touch=False)
+    assert c.hits == 4 and c.misses == 1
+
+
+def test_prefix_insert_dedup_keeps_first_writer():
+    a = BlockAllocator(num_pages=16, page_len=4)
+    c = PrefixCache(a, page_len=4)
+    donor = list(range(50, 58))
+    pages = _cached(a, c, donor)
+    # a second slot computed the same chunks on its own pages: existing
+    # edges win, nothing new inserted, no extra refs taken
+    dup = [a.alloc(), a.alloc()]
+    assert c.insert(donor, dup) == 0
+    assert c.match(donor).pages == pages
+    assert a.refcount(dup[0]) == 1  # still only the slot's own ref
+
+
+def test_prefix_evict_lru_leaves_cascade():
+    a = BlockAllocator(num_pages=16, page_len=4)
+    c = PrefixCache(a, page_len=4)
+    old = _cached(a, c, list(range(0, 8)))      # 2 chunks
+    new = _cached(a, c, list(range(20, 28)))    # 2 chunks
+    c.match(list(range(20, 28)))                # bump 'new' to MRU
+    assert c.evictable_count() == 2             # only the two leaves
+    free0 = a.free_count()
+    assert c.evict(1) == 1                      # LRU leaf: old's chunk 2
+    assert a.free_count() == free0 + 1
+    assert c.match(list(range(0, 8))).pages == old[:1]
+    # cascading: evicting the leaf exposed old's chunk 1
+    assert c.evict(3) == 3                      # old chunk1 + both of new
+    assert c.resident_pages() == 0 and c.evictions == 4
+    # a page a live slot still references is pinned: nothing to evict
+    pinned = _cached(a, c, list(range(40, 44)))
+    a.incref(pinned[0])  # a slot's block-table entry
+    assert c.evictable_count() == 0 and c.evict(1) == 0
+
+
+# ---------------------------------------------------------------------------
+# PagedKVPool: admission plans, CoW, release
+# ---------------------------------------------------------------------------
+
+
+def test_pool_admit_miss_then_full_chunk_share():
+    pool = PagedKVPool(num_pages=12, page_len=4, num_slots=2,
+                       pages_per_slot=8)
+    prompt = list(range(200, 210))  # 10 tokens = 2 full chunks + 2
+    plan = pool.admit(0, prompt, budget=3)  # last write at pos 10+3-2 -> 3 pages
+    assert plan.chunk_starts == [0, 4, 8] and not plan.null_target
+    assert plan.prefix_tokens == 0 and not plan.shared_tail
+    row0 = list(pool.block_table[0][:3])
+    assert row0 == [1, 2, 3]
+    pool.register(0, prompt)   # 2 full chunks become resident
+    pool.release(0)
+    assert (pool.block_table[0] == NULL_PAGE).all()
+    assert pool.allocator.refcount(1) == 1  # cache residency survives
+    assert pool.allocator.refcount(3) == 0  # decode page freed
+
+    # same prompt again: leading chunks shared, only the tail prefilled
+    plan = pool.admit(1, prompt, budget=3)
+    assert plan.prefix_tokens == 8 and plan.chunk_starts == [8]
+    assert list(pool.block_table[1][:2]) == row0[:2]
+    assert pool.allocator.refcount(1) == 2  # cache + slot 1
+
+
+def test_pool_partial_tail_cow_and_null_target():
+    pool = PagedKVPool(num_pages=16, page_len=4, num_slots=2,
+                       pages_per_slot=8)
+    donor = list(range(300, 312))  # 3 full chunks
+    pool.admit(0, donor, budget=2)
+    pool.register(0, donor)
+    pool.release(0)
+
+    # prompt ends INSIDE donor's 3rd chunk: tail page shared, fully
+    # covered -> single null-target chunk just for the first token's logits
+    prompt = donor[:10]
+    plan = pool.admit(1, prompt, budget=4)
+    assert plan.shared_tail and plan.null_target
+    assert plan.prefix_tokens == 10 and plan.chunk_starts == [8]
+    tail_idx = len(prompt) // 4
+    shared_tail = int(pool.block_table[1][tail_idx])
+    assert pool.allocator.refcount(shared_tail) >= 2
+    # the chunk's prefill view is redirected to the null page; the
+    # authoritative table is untouched
+    view = pool.chunk_row(1, plan.chunk_starts[0], plan.null_target)
+    assert view[tail_idx] == NULL_PAGE
+    assert int(pool.block_table[1][tail_idx]) == shared_tail
+
+    # first decode append diverges from the cached content: CoW repoints
+    # the tail at the reserved private page, donor's page keeps its holders
+    cow = pool.resolve_cow(1)
+    assert cow is not None
+    dst, src = cow
+    assert src == shared_tail and int(pool.block_table[1][tail_idx]) == dst
+    assert pool.allocator.refcount(src) == 1  # cache residency only
+    assert pool.cow_copies == 1
+    assert pool.resolve_cow(1) is None  # idempotent
+    pool.release(1)
+    assert pool.allocator.refcount(dst) == 0
+
+
+def test_pool_page_math_and_capacity():
+    pool = PagedKVPool(num_pages=8, page_len=4, num_slots=1,
+                       pages_per_slot=7, prefix_cache=False)
+    # budget=1: the single emitted token is computed, never written
+    assert pool.worst_case_pages(4, 1) == 1
+    assert pool.worst_case_pages(5, 1) == 2
+    # budget>1: budget-1 decode scatters land after the prompt
+    assert pool.worst_case_pages(3, 2) == 1
+    assert pool.worst_case_pages(4, 2) == 2
+    assert pool.worst_case_pages(8, 5) == 3
+    assert pool.capacity() == 7  # no prefix cache: free pages only
+    pool.admit(0, list(range(10)), budget=3)
+    assert pool.capacity() == 4
+
+
+# ---------------------------------------------------------------------------
+# scheduler: bounded reorder window
+# ---------------------------------------------------------------------------
+
+
+def _req(rid, n):
+    return Request(request_id=rid, prompt=[1] * n, max_new_tokens=4,
+                   stream=ResponseStream(rid))
+
+
+def test_scheduler_reorder_window_relieves_blocked_head():
+    s = Scheduler(EngineConfig(max_queue=16, reorder_window=2))
+    for rid, n in enumerate([8, 2, 3, 9, 2]):  # big head, smalls behind
+        s.submit(_req(rid, n))
+    fits = lambda r: len(r.prompt) < 5
+    out = s.pop_admissible(3, can_admit=fits)
+    # head (r0) blocked each round; window=2 look-ahead admits in queue
+    # order: r1, r2, then r4 (r3 also blocked)
+    assert [r.request_id for r in out] == [1, 2, 4]
+    assert s.reordered_admits == 3
+    assert s.depth() == 2  # r0, r3 still queued, order preserved
+    out = s.pop_admissible(2, can_admit=lambda r: True)
+    assert [r.request_id for r in out] == [0, 3]
+
+
+def test_scheduler_reorder_window_zero_is_strict_fifo():
+    s = Scheduler(EngineConfig(max_queue=16, reorder_window=0))
+    for rid, n in enumerate([8, 2, 2]):
+        s.submit(_req(rid, n))
+    assert s.pop_admissible(3, can_admit=lambda r: len(r.prompt) < 5) == []
+    assert s.reordered_admits == 0 and s.depth() == 3
+
+
+# ---------------------------------------------------------------------------
+# the paged engine, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_paged_engine_matches_offline_and_slab(lm):
+    """The ISSUE acceptance anchor: the paged engine is token-identical to
+    offline greedy generate — and to the slab engine — on the same burst."""
+    cfg, model, params = lm
+    prompts = _prompts(seed=21, n=6)
+    max_new = 8
+    outs = {}
+    for mode in ("paged", "slab"):
+        engine = InferenceEngine(
+            model, params,
+            EngineConfig(num_slots=3, slot_len=64, max_new_tokens=max_new,
+                         kv_mode=mode, page_len=8),
+            auto_start=False, name=f"kvpool-parity-{mode}",
+        )
+        streams = [engine.submit(p) for p in prompts]
+        _drain(engine)
+        outs[mode] = [s.result(5.0) for s in streams]
+        engine.close()
+    want = [_offline(model, params, p, max_new) for p in prompts]
+    assert outs["paged"] == want
+    assert outs["slab"] == want
+
+
+def test_paged_engine_prefix_hits_and_cow(lm):
+    """Shared system prompt: the second request skips the covered chunks
+    (prefix hit), a mid-chunk cut triggers exactly one copy-on-write, and
+    every stream stays token-identical to offline generate."""
+    cfg, model, params = lm
+    rng = np.random.RandomState(31)
+    sys_prompt = list(map(int, rng.randint(1, 384, size=16)))  # 2 full pages
+    a = sys_prompt + list(map(int, rng.randint(1, 384, size=8)))  # 3 pages
+    b = sys_prompt + list(map(int, rng.randint(1, 384, size=5)))
+    tail = a[:20]  # ends inside a's 3rd page -> partial-tail share + CoW
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=2, slot_len=64, max_new_tokens=6, page_len=8),
+        auto_start=False, name="kvpool-prefix",
+    )
+    results = []
+    for p in (a, b, tail):  # sequential: each later prompt sees the cache
+        s = engine.submit(p)
+        _drain(engine)
+        results.append(s.result(5.0))
+    stats = engine.pool.stats()
+    engine.close()
+    for p, got in zip((a, b, tail), results):
+        assert got == _offline(model, params, p, 6)
+    assert stats["prefix_hits"] == 2           # b and tail both hit
+    assert stats["prefix_partial_hits"] == 1   # tail shared a's 3rd page
+    assert stats["cow_copies"] == 1
+    assert stats["prefix_tokens_reused"] == 16 + 20  # b's chunks + all of tail
+
+
+def test_chunked_prefill_keeps_short_ttft_flat(lm):
+    """A 40-token prompt prefills in page-sized chunks; a short prompt
+    arriving alongside it reaches its first token in the SAME number of
+    engine steps as it does on an idle engine (flat TTFT), while the long
+    prompt's chunks interleave behind it."""
+    cfg, model, params = lm
+
+    def steps_to_first(engine, stream):
+        steps = 0
+        while not stream.tokens_so_far():
+            assert engine.step(), "engine idle before first token"
+            steps += 1
+        return steps
+
+    def fresh():
+        return InferenceEngine(
+            model, params,
+            EngineConfig(num_slots=2, slot_len=64, max_new_tokens=6,
+                         page_len=8, prefill_chunks_per_step=1),
+            auto_start=False, name="kvpool-ttft",
+        )
+
+    rng = np.random.RandomState(41)
+    long_p = list(map(int, rng.randint(1, 384, size=40)))  # 5 chunks
+    short_p = list(map(int, rng.randint(1, 384, size=5)))  # 1 chunk
+
+    engine = fresh()
+    baseline = steps_to_first(engine, engine.submit(short_p))
+    _drain(engine)
+    engine.close()
+
+    engine = fresh()
+    s_long = engine.submit(long_p)
+    s_short = engine.submit(short_p)
+    loaded = steps_to_first(engine, s_short)
+    # the short prompt's single chunk runs first (shortest-remaining-first)
+    assert loaded == baseline
+    # the long prompt is still mid-prefill: its 5 chunks run one per step
+    assert not s_long.tokens_so_far()
+    long_first = loaded + steps_to_first(engine, s_long)
+    assert long_first >= 5
+    # and the short request kept decoding underneath the long prefill
+    assert len(s_short.tokens_so_far()) > 1
+    _drain(engine)
+    assert s_short.result(5.0) == _offline(model, params, short_p, 6)
+    assert s_long.result(5.0) == _offline(model, params, long_p, 6)
+    assert engine.metrics.snapshot()["prefill_chunks"] == 6
+    engine.close()
+
+
+def test_paged_engine_defers_on_pool_exhaustion(lm):
+    """A request whose worst case exceeds the free pages waits; a small one
+    behind it jumps the line (reorder window); the big one admits after
+    pages free up.  Streams stay token-identical throughout."""
+    cfg, model, params = lm
+    rng = np.random.RandomState(51)
+    big_a = list(map(int, rng.randint(1, 384, size=20)))  # wc 4 pages @ b=6
+    big_b = list(map(int, rng.randint(1, 384, size=21)))  # wc 4 pages
+    small = list(map(int, rng.randint(1, 384, size=4)))   # wc 1 page
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=2, slot_len=32, max_new_tokens=6, page_len=8,
+                     num_pages=6, reorder_window=2),  # 5 usable pages
+        auto_start=False, name="kvpool-oom",
+    )
+    s_a = engine.submit(big_a)
+    s_b = engine.submit(big_b)
+    s_small = engine.submit(small, max_new_tokens=4)
+    engine.step()
+    # round 1: A reserved 4 of 5 pages, B (4 more) deferred, small (1) jumped
+    assert engine.scheduler.depth() == 1
+    assert engine.scheduler.reordered_admits == 1
+    _drain(engine)
+    assert s_a.result(5.0) == _offline(model, params, big_a, 6)
+    assert s_b.result(5.0) == _offline(model, params, big_b, 6)
+    assert s_small.result(5.0) == _offline(model, params, small, 4)
+    assert engine.metrics.snapshot()["requests_completed"] == 3
+    engine.close()
+
+
+def test_kvpool_gauges_reach_snapshot_and_prometheus(lm):
+    cfg, model, params = lm
+    from tpu_air.engine.metrics import prometheus_lines
+
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=2, slot_len=64, max_new_tokens=4, page_len=8),
+        auto_start=False, name="kvpool-gauges",
+    )
+    engine.generate(_prompts(seed=61, n=3))
+    snap = engine.metrics.snapshot()
+    assert snap["kvpool"]["pages_total"] == 2 * 8  # slab-equivalent pool
+    # drained: the only allocated pages are prefix-cache residency
+    assert snap["kvpool"]["pages_used"] == snap["kvpool"][
+        "prefix_resident_pages"]
+    assert snap["kvpool"]["pages_free"] + snap["kvpool"][
+        "pages_used"] == snap["kvpool"]["pages_total"]
+    assert 0.0 <= snap["kvpool"]["prefix_hit_rate"] <= 1.0
+    assert snap["prefill_chunks"] >= 3
+    assert snap["reordered_admits"] == 0
+    text = "\n".join(prometheus_lines({snap["name"]: snap}))
+    assert 'tpu_air_engine_kvpool_pages_free{engine="kvpool-gauges"}' in text
+    assert 'tpu_air_engine_kvpool_prefix_hit_rate{engine="kvpool-gauges"}' in text
+    assert 'tpu_air_engine_prefill_chunks{engine="kvpool-gauges"}' in text
+    assert 'tpu_air_engine_ttft_s_p95{engine="kvpool-gauges"}' in text
+    engine.close()
+
+
+# ---------------------------------------------------------------------------
+# T5 window engine
+# ---------------------------------------------------------------------------
+
+
+def test_t5_window_engine_matches_offline_generate():
+    from tpu_air.models.t5 import T5Config, T5ForConditionalGeneration
+    from tpu_air.models.t5.generate import generate as t5_generate
+
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    enc = jnp.ones((2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), enc, jnp.ones_like(enc),
+                        jnp.ones((2, 6), jnp.int32))["params"]
+    rng = np.random.RandomState(71)
+    prompts = [list(map(int, rng.randint(2, 384, size=rng.randint(3, 8))))
+               for _ in range(5)]
+    max_new = 6
+
+    # offline reference: one padded batch; T5 rows are batch-independent,
+    # so grouping differences between this and the engine's windows can't
+    # change any row's tokens
+    li = max(len(p) for p in prompts)
+    ids = np.full((len(prompts), li), cfg.pad_token_id, np.int32)
+    for r, p in enumerate(prompts):
+        ids[r, :len(p)] = p
+    mask = (ids != cfg.pad_token_id).astype(np.int32)
+    ref = np.asarray(t5_generate(model, params, jnp.asarray(ids),
+                                 attention_mask=jnp.asarray(mask),
+                                 max_new_tokens=max_new, early_stop=False))
+    want = []
+    for row in ref.tolist():  # engine emits EOS inclusive, then retires
+        if cfg.eos_token_id in row:
+            row = row[: row.index(cfg.eos_token_id) + 1]
+        want.append(row)
+
+    # 5 prompts through max_batch=2 windows: 3 windows, per-row retirement
+    engine = T5Engine(
+        model, params,
+        T5EngineConfig(max_batch=2, max_input_len=8, max_new_tokens=max_new),
+        auto_start=False, name="t5-window-test",
+    )
+    streams = [engine.submit(p) for p in prompts]
+    steps = 0
+    while not engine.idle():
+        engine.step()
+        steps += 1
+        assert steps < 200, "t5 engine failed to drain"
+    for s, w in zip(streams, want):
+        assert s.result(5.0) == w
+    assert engine.metrics.snapshot()["requests_completed"] == 5
+    engine.close()

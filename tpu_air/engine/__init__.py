@@ -1,15 +1,28 @@
 """tpu_air.engine — continuous-batching online inference.
 
-A fixed pool of sequence slots over flat per-layer KV slabs, one
+A fixed pool of sequence slots over per-layer KV storage — block-table
+PAGED pools with prefix sharing and chunked prefill by default
+(``kvpool/``), or the PR 1 flat slabs (``kv_mode="slab"``) — one
 persistent compiled decode step, admission/retirement between steps, and
-per-token streaming back to callers.  See docs/SERVING.md for the
-architecture and the token-parity contract with offline ``generate``.
+per-token streaming back to callers.  The T5 family runs through
+:class:`T5Engine`, a window-level variant over the batch-synchronized T5
+decode entry points.  See docs/SERVING.md for the architecture and the
+token-parity contract with offline ``generate``.
 """
 
 from .engine import InferenceEngine
+from .kvpool import (
+    AdmitPlan,
+    BlockAllocator,
+    KVPoolOOMError,
+    PagedKVPool,
+    PrefixCache,
+    PrefixMatch,
+)
 from .metrics import EngineMetrics, snapshot_all
 from .scheduler import Scheduler
 from .slots import Slot, SlotManager, make_insert_fn
+from .t5_engine import T5Engine, T5EngineConfig
 from .types import (
     EngineClosedError,
     EngineConfig,
@@ -19,16 +32,24 @@ from .types import (
 )
 
 __all__ = [
+    "AdmitPlan",
+    "BlockAllocator",
     "EngineClosedError",
     "EngineConfig",
     "EngineMetrics",
     "EngineOverloadedError",
     "InferenceEngine",
+    "KVPoolOOMError",
+    "PagedKVPool",
+    "PrefixCache",
+    "PrefixMatch",
     "Request",
     "ResponseStream",
     "Scheduler",
     "Slot",
     "SlotManager",
+    "T5Engine",
+    "T5EngineConfig",
     "make_insert_fn",
     "snapshot_all",
 ]

@@ -1,30 +1,38 @@
 """The continuous-batching inference engine.
 
-One :class:`InferenceEngine` owns a fixed pool of ``S`` sequence slots
-backed by per-layer flat KV slabs ``[S, slot_len, h*d]`` and keeps a single
-persistent jit-compiled decode step alive over that pool for its whole
-lifetime (the cache is donated — slabs update in place, never copied).
+One :class:`InferenceEngine` owns a fixed pool of ``S`` sequence slots and
+keeps a single persistent jit-compiled decode step alive over that pool for
+its whole lifetime (the cache is donated — device KV updates in place,
+never copied).  Two KV layouts share the host loop (``EngineConfig.kv_mode``):
+
+* **paged** (default) — per-layer page pools ``[P, page_len, h*d]`` plus a
+  host block table mapping slot positions onto refcounted pages
+  (engine/kvpool/).  Prompts prefill in page-sized CHUNKS interleaved
+  between decode steps (one compiled chunk program covers every prompt
+  length); prompts sharing a cached prefix skip the covered chunks and
+  share the physical pages, copy-on-write on the first divergent append.
+* **slab** — the PR 1 layout: one private ``[slot_len]`` KV row per slot,
+  whole-prompt bucketed prefill.  Kept as the bench baseline and for the
+  T5 window engine.
+
 Requests flow through three host-side phases BETWEEN device steps:
 
-1. **admission** — FIFO from the scheduler queue, up to the number of free
-   slots.  Each admitted prompt is right-padded to its length bucket,
-   prefilled (B=1, one compile per bucket), and its KV segment grafted into
-   the free slab row with one jitted ``dynamic_update_slice``.  The first
-   greedy token comes out of prefill itself — TTFT does not wait for the
-   next pool step.
-2. **decode** — one fixed-shape step over all ``S`` rows.  Free rows ride
-   along (pos 0, output discarded host-side); occupied rows each scatter
-   their token's K/V to ``(row, pos[row])`` and attend under a per-row
-   validity mask, so slots at wildly different positions share the step.
-3. **retirement** — a row that emits EOS (inclusive — the EOS id is
-   delivered, matching offline ``generate``) or exhausts its budget is
-   released on the very next host visit; no slab zeroing (stale K/V beyond
-   a new occupant's written positions are masked, then overwritten).
+1. **admission** — FIFO from the scheduler queue (paged: gated on KV-page
+   capacity with a bounded reorder window so a big blocked head can't
+   starve small requests behind it).
+2. **prefill** — slab: one bucketed B=1 prefill per request, grafted into
+   the slab row; paged: up to ``prefill_chunks_per_step`` chunk calls per
+   engine step, shortest-remaining-prompt first, so short-request TTFT
+   stays flat while long prompts stream in.
+3. **decode + retirement** — one fixed-shape step over all ``S`` rows;
+   a row that emits EOS (inclusive) or exhausts its budget is released on
+   the next host visit (paged: its private pages return to the free list;
+   its prompt's pages stay resident in the prefix cache for future hits).
 
 Correctness anchor: with greedy decoding the engine's emitted tokens are
-token-identical to offline ``generate()`` on the same prompts —
-tests/test_engine.py pins this on CPU for burst, staggered and trickle
-arrival schedules.
+token-identical to offline ``generate()`` on the same prompts — in BOTH
+kv modes — tests/test_engine.py pins this on CPU for burst, staggered and
+trickle arrival schedules.
 """
 
 from __future__ import annotations
@@ -38,13 +46,18 @@ import numpy as np
 import jax.numpy as jnp
 
 from tpu_air.models.lm.generate import (
+    init_paged_cache,
     init_slot_cache,
     make_lm_decode_step_fn,
+    make_lm_paged_decode_step_fn,
+    make_lm_prefill_chunk_fn,
     make_lm_prefill_fn,
+    make_page_copy_fn,
 )
 
 from tpu_air.observability import tracing as _tracing
 
+from .kvpool import PagedKVPool
 from .metrics import EngineMetrics, unregister
 from .scheduler import Scheduler
 from .slots import Slot, SlotManager, make_insert_fn
@@ -84,16 +97,37 @@ class InferenceEngine:
                 f"slot_len {cfg.slot_len} exceeds the model's max_seq_len "
                 f"{model.config.max_seq_len}"
             )
+        if cfg.kv_mode not in ("paged", "slab"):
+            raise ValueError(f"unknown kv_mode {cfg.kv_mode!r}")
+        self.paged = cfg.kv_mode == "paged"
 
-        # device side: the persistent donated slab pool + compiled phases
-        self.cache = init_slot_cache(model, cfg.num_slots, cfg.slot_len)
-        self._decode_step = make_lm_decode_step_fn(model, cfg.slot_len)
-        self._insert = make_insert_fn()
-        self._prefill_fns: Dict[int, Any] = {}  # bucket -> compiled prefill
+        # device side: the persistent donated KV pool + compiled phases
+        if self.paged:
+            self.pool = PagedKVPool(
+                cfg.pool_pages(), cfg.page_len, cfg.num_slots,
+                cfg.pages_per_slot(), prefix_cache=cfg.prefix_cache,
+            )
+            self.cache = init_paged_cache(
+                model, cfg.num_slots, cfg.pool_pages(), cfg.page_len,
+                cfg.pages_per_slot(),
+            )
+            self._decode_step = make_lm_paged_decode_step_fn(
+                model, cfg.slot_len)
+            self._chunk_fn = make_lm_prefill_chunk_fn(
+                model, cfg.page_len, cfg.slot_len)
+            self._copy_fn = make_page_copy_fn()
+        else:
+            self.pool = None
+            self.cache = init_slot_cache(model, cfg.num_slots, cfg.slot_len)
+            self._decode_step = make_lm_decode_step_fn(model, cfg.slot_len)
+            self._insert = make_insert_fn()
+            self._prefill_fns: Dict[int, Any] = {}  # bucket -> compiled
 
         # host side: authoritative per-slot state the step args come from
         self._cur_tok = np.zeros((cfg.num_slots,), np.int32)
         self._pos = np.zeros((cfg.num_slots,), np.int32)
+        self._round_reserved = 0   # pages promised during one admission round
+        self._chunks_run = 0       # prefill chunk calls, engine lifetime
 
         self.scheduler = Scheduler(cfg)
         self.slots = SlotManager(cfg.num_slots)
@@ -152,25 +186,132 @@ class InferenceEngine:
 
     # -- the engine loop -----------------------------------------------------
     def step(self) -> bool:
-        """One deterministic engine iteration: admit into free slots, then
-        one pool decode step if anything is active.  Returns True if any
-        work happened (callers loop ``while engine.step(): ...`` to drain)."""
+        """One deterministic engine iteration: admit into free slots, run
+        the prefill quantum (paged), then one pool decode step if anything
+        is decoding.  Returns True if any work happened (callers loop
+        ``while engine.step(): ...`` to drain)."""
         with self._step_lock:
             worked = False
-            for req in self.scheduler.pop_admissible(self.slots.free_count()):
-                self._admit(req)
+            self._round_reserved = 0
+            can_admit = self._can_admit if self.paged else None
+            for req in self.scheduler.pop_admissible(
+                self.slots.free_count(), can_admit
+            ):
+                if self.paged:
+                    self._admit_paged(req)
+                else:
+                    self._admit(req)
                 worked = True
-            if self.slots.occupancy():
+            if self.paged and self._prefill_quantum():
+                worked = True
+            if any(not s.prefilling for s in self.slots.active_slots()):
                 self._decode_all()
                 worked = True
+            gauges: Dict[str, Any] = {}
+            if self.paged:
+                gauges = dict(
+                    kvpool=self.pool.stats(),
+                    reordered_admits=self.scheduler.reordered_admits,
+                    prefill_chunks=self._chunks_run,
+                )
             self.metrics.observe_gauges(
-                self.scheduler.depth(), self.slots.occupancy()
+                self.scheduler.depth(), self.slots.occupancy(), **gauges
             )
             return worked
 
     def idle(self) -> bool:
         return self.scheduler.depth() == 0 and self.slots.occupancy() == 0
 
+    # -- paged admission -----------------------------------------------------
+    def _can_admit(self, req: Request) -> bool:
+        """Page-capacity gate for the scheduler: answers whether the pool
+        can cover the request's WORST CASE (no prefix sharing — a prior
+        admit's eviction may invalidate a probe-time match, and shared
+        pages stop being evictable, so the conservative bound is exactly
+        what one round can consume).  A True answer RESERVES the pages for
+        the rest of the round."""
+        need = self.pool.worst_case_pages(len(req.prompt), req.max_new_tokens)
+        if self.slots.free_count() == 0:
+            return False
+        if self._round_reserved + need > self.pool.capacity():
+            return False
+        self._round_reserved += need
+        return True
+
+    def _admit_paged(self, req: Request) -> None:
+        """Reserve pages + block-table row; actual compute happens in the
+        chunked prefill quantum (no first token yet — TTFT lands when the
+        final chunk runs)."""
+        slot = self.slots.acquire()
+        slot.request = req
+        slot.prefilling = True
+        slot.plan = self.pool.admit(slot.index, req.prompt, req.max_new_tokens)
+
+    def _prefill_quantum(self) -> bool:
+        """Run up to ``prefill_chunks_per_step`` prefill chunk calls,
+        SHORTEST-REMAINING-PROMPT first (ties: request id = arrival order).
+        Bounding the per-step quantum keeps any single long prompt from
+        stalling in-flight decodes; preferring short remainders keeps
+        short-request TTFT flat while a long prompt streams in."""
+        ran = False
+        for _ in range(max(1, self.config.prefill_chunks_per_step)):
+            pending = [s for s in self.slots.active_slots() if s.prefilling]
+            if not pending:
+                break
+            slot = min(
+                pending,
+                key=lambda s: (s.plan.chunks_left, s.request.request_id),
+            )
+            self._run_chunk(slot)
+            ran = True
+        return ran
+
+    def _run_chunk(self, slot: Slot) -> None:
+        plan = slot.plan
+        req = slot.request
+        cfg = self.config
+        C = cfg.page_len
+        p0 = plan.next_start
+        n = plan.prompt_len
+        ids = np.full((1, C), self.model.config.pad_token_id, np.int32)
+        chunk_toks = req.prompt[p0:p0 + C]
+        ids[0, :len(chunk_toks)] = chunk_toks
+        is_last = plan.chunks_done == len(plan.chunk_starts) - 1
+        last_local = (n - 1 - p0) if is_last else (C - 1)
+        row = self.pool.chunk_row(slot.index, p0, plan.null_target)
+        self.cache, tok = self._chunk_fn(
+            self.params, self.cache, jnp.asarray(ids), jnp.int32(p0),
+            jnp.int32(last_local), jnp.asarray(row),
+        )
+        plan.chunks_done += 1
+        self._chunks_run += 1
+        if not plan.done:
+            return
+        # final chunk: first token, publication, CoW, hand over to decode
+        first = int(np.asarray(tok))
+        req.first_token_at = time.monotonic()
+        if req.t_submit_ns:  # traced request: stamp TTFT for span emission
+            req.t_first_ns = _tracing.now_ns()
+        self.metrics.record_ttft(req.first_token_at - req.submitted_at)
+        req.stream._emit(first)
+        self.metrics.record_tokens(1)  # prefill's first token
+        self.pool.register(slot.index, req.prompt)
+        cow = self.pool.resolve_cow(slot.index)
+        if cow is not None:
+            dst, src = cow
+            self.cache = self._copy_fn(
+                self.cache, jnp.int32(dst), jnp.int32(src))
+        slot.prefilling = False
+        slot.pos = n
+        slot.budget_left = req.max_new_tokens - 1
+        self._cur_tok[slot.index] = first
+        self._pos[slot.index] = n
+        if slot.budget_left == 0 or (
+            self.eos_token_id is not None and first == self.eos_token_id
+        ):
+            self._retire(slot)
+
+    # -- slab admission ------------------------------------------------------
     def _prefill_for(self, bucket: int):
         if bucket not in self._prefill_fns:
             self._prefill_fns[bucket] = make_lm_prefill_fn(self.model, bucket)
@@ -205,16 +346,33 @@ class InferenceEngine:
         ):
             self._retire(slot)
 
+    # -- decode --------------------------------------------------------------
     def _decode_all(self) -> None:
         t0 = time.monotonic()
-        self.cache, nxt = self._decode_step(
-            self.params, self.cache,
-            jnp.asarray(self._cur_tok), jnp.asarray(self._pos),
-        )
+        if self.paged:
+            # non-decoding rows (free OR mid-prefill) ride along pointed at
+            # the null page: their ride-along scatter can't touch a live or
+            # prefix-shared page.  The authoritative table stays host-side.
+            table = self.pool.block_table.copy()
+            for s in self.slots.slots:
+                if not s.active or s.prefilling:
+                    table[s.index] = 0
+            self.cache, nxt = self._decode_step(
+                self.params, self.cache,
+                jnp.asarray(self._cur_tok), jnp.asarray(self._pos),
+                jnp.asarray(table),
+            )
+        else:
+            self.cache, nxt = self._decode_step(
+                self.params, self.cache,
+                jnp.asarray(self._cur_tok), jnp.asarray(self._pos),
+            )
         nxt = np.asarray(nxt)
         dt = time.monotonic() - t0
         emitted = 0
         for slot in self.slots.active_slots():
+            if slot.prefilling:
+                continue
             # airlint: disable=JX004 — nxt is the np.asarray'd step result;
             # the single device sync already happened above the loop
             token = int(nxt[slot.index])
@@ -230,11 +388,16 @@ class InferenceEngine:
                 self._retire(slot)
         self.metrics.record_step(dt, emitted)
 
+    # -- retirement ----------------------------------------------------------
     def _retire(self, slot: Slot) -> None:
         if slot.request.t_submit_ns:
             self._emit_request_spans(slot)
         slot.request.stream._finish()
         self.metrics.record_complete()
+        if self.paged:
+            # private pages return to the free list; prompt pages the prefix
+            # cache registered stay resident for future hits
+            self.pool.release(slot.index)
         self.slots.release(slot)
         self._cur_tok[slot.index] = 0
         self._pos[slot.index] = 0
@@ -262,15 +425,18 @@ class InferenceEngine:
                 start_ns=req.t_submit_ns, end_ns=req.t_admit_ns,
             )
         if req.t_admit_ns and req.t_first_ns:
+            attrs = {"slot": slot.index, "prompt_len": len(req.prompt)}
+            if self.paged and slot.plan is not None:
+                attrs["chunks"] = len(slot.plan.chunk_starts)
+                attrs["prefix_hit"] = slot.plan.prefix_tokens > 0
+                attrs["prefix_tokens"] = slot.plan.prefix_tokens
+            elif not self.paged:
+                attrs["bucket"] = self.config.bucket_for(len(req.prompt))
             _tracing.record_span(
                 "engine.prefill",
                 trace_id=root.trace_id, parent_id=root.span_id,
                 start_ns=req.t_admit_ns, end_ns=req.t_first_ns,
-                attrs={
-                    "slot": slot.index,
-                    "prompt_len": len(req.prompt),
-                    "bucket": self.config.bucket_for(len(req.prompt)),
-                },
+                attrs=attrs,
             )
         if req.t_first_ns:
             _tracing.record_span(
@@ -312,6 +478,8 @@ class InferenceEngine:
                 req.stream._finish(err)
             for slot in self.slots.active_slots():
                 slot.request.stream._finish(err)
+                if self.paged:
+                    self.pool.release(slot.index)
                 self.slots.release(slot)
         unregister(self.name)
 

@@ -29,6 +29,7 @@ def _dist(samples) -> Dict[str, float]:
         "count": len(xs),
         "mean": sum(xs) / len(xs),
         "p50": xs[len(xs) // 2],
+        "p95": xs[min(len(xs) - 1, int(len(xs) * 0.95))],
         "p99": xs[min(len(xs) - 1, int(len(xs) * 0.99))],
         "max": xs[-1],
     }
@@ -53,13 +54,27 @@ class EngineMetrics:
         self._ttft_s: Deque[float] = deque(maxlen=_WINDOW)
         self._step_s: Deque[float] = deque(maxlen=_WINDOW)
         self._token_stamps: Deque[Any] = deque()  # (t, n) for tokens/s
+        # paged-KV gauges (empty for slab engines — snapshot shape is then
+        # unchanged from the slab era)
+        self.kvpool: Dict[str, Any] = {}
+        self.reordered_admits = 0
+        self.prefill_chunks = 0
         register(self)
 
     # -- engine-side recording ----------------------------------------------
-    def observe_gauges(self, queue_depth: int, slot_occupancy: int) -> None:
+    def observe_gauges(self, queue_depth: int, slot_occupancy: int,
+                       kvpool: Dict[str, Any] = None,
+                       reordered_admits: int = None,
+                       prefill_chunks: int = None) -> None:
         with self._lock:
             self.queue_depth = queue_depth
             self.slot_occupancy = slot_occupancy
+            if kvpool is not None:
+                self.kvpool = dict(kvpool)
+            if reordered_admits is not None:
+                self.reordered_admits = reordered_admits
+            if prefill_chunks is not None:
+                self.prefill_chunks = prefill_chunks
 
     def record_submit(self) -> None:
         with self._lock:
@@ -132,6 +147,10 @@ class EngineMetrics:
                 "ttft_s": _dist(self._ttft_s),
                 "step_latency_s": _dist(self._step_s),
             }
+            if self.kvpool:
+                out["kvpool"] = dict(self.kvpool)
+                out["reordered_admits"] = self.reordered_admits
+                out["prefill_chunks"] = self.prefill_chunks
         out["tokens_per_s"] = self.tokens_per_s()
         return out
 
@@ -187,4 +206,15 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
                 lines.append(
                     f"tpu_air_engine_{dist_key}_p50{tag} {d['p50']:.6f}"
                 )
+                lines.append(
+                    f"tpu_air_engine_{dist_key}_p95{tag} {d['p95']:.6f}"
+                )
+        # paged-KV pool gauges (absent on slab engines)
+        for key, val in sorted((snap.get("kvpool") or {}).items()):
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            lines.append(f"tpu_air_engine_kvpool_{key}{tag} {val:g}")
+        for key in ("reordered_admits", "prefill_chunks"):
+            if key in snap:
+                lines.append(f"tpu_air_engine_{key}{tag} {snap[key]}")
     return lines

@@ -1,12 +1,18 @@
-"""Admission scheduler: FIFO queue with backpressure + bucket grouping.
+"""Admission scheduler: FIFO queue with backpressure + a bounded reorder
+window.
 
-Policy (docs/SERVING.md §scheduling): requests are admitted strictly in
-arrival order — never reordered for bucket affinity — up to the number of
-free slots each engine step.  FIFO keeps the scheduler DETERMINISTIC for a
-given arrival schedule, which is what the engine's token-parity gate tests
-against; bucket grouping is only an ordering hint WITHIN one admission
-round so same-bucket prefills sit adjacent (shared compiled program,
-warm icache), not a reordering across rounds.
+Policy (docs/SERVING.md §scheduling): requests are admitted in arrival
+order up to the number of free slots each engine step.  The paged engine
+additionally passes a ``can_admit`` predicate (does the KV pool have pages
+for this request right now?) — and a blocked HEAD no longer blocks the
+whole queue: admission may look at most ``reorder_window`` entries past the
+first request that does not fit and admit later ones that do (a big-prompt
+head waiting for pages can't head-of-line-block a stream of small requests
+that would fit today).  Every such out-of-order admission increments
+``reordered_admits``.  ``reorder_window=0`` (or no ``can_admit``) restores
+strict FIFO, which keeps the scheduler DETERMINISTIC for a given arrival
+schedule — what the engine's token-parity gate tests against; the window
+itself is also deterministic: lowest-index fitting candidate wins.
 """
 
 from __future__ import annotations
@@ -28,6 +34,7 @@ class Scheduler:
         self._queue: Deque[Request] = deque()
         self._lock = threading.Lock()
         self._work = threading.Event()
+        self.reordered_admits = 0  # admissions that jumped a blocked head
 
     # -- producer side (any thread) ------------------------------------------
     def submit(self, request: Request) -> None:
@@ -48,12 +55,37 @@ class Scheduler:
             self._work.set()
 
     # -- engine-loop side ----------------------------------------------------
-    def pop_admissible(self, free_slots: int) -> List[Request]:
-        """Dequeue up to ``free_slots`` requests in FIFO order."""
+    def pop_admissible(self, free_slots: int,
+                       can_admit=None) -> List[Request]:
+        """Dequeue up to ``free_slots`` requests in FIFO order.
+
+        ``can_admit(request) -> bool`` (optional) gates each candidate on
+        engine-side capacity (KV pages, for the paged pool); the engine's
+        predicate RESERVES capacity when it answers True, so one round
+        never over-admits.  When the head is blocked, up to
+        ``config.reorder_window`` later entries are considered in queue
+        order (head-of-line relief); out-of-order takes are counted in
+        :attr:`reordered_admits`."""
         out: List[Request] = []
+        window = getattr(self.config, "reorder_window", 0)
         with self._lock:
             while self._queue and len(out) < free_slots:
-                out.append(self._queue.popleft())
+                if can_admit is None or can_admit(self._queue[0]):
+                    out.append(self._queue.popleft())
+                    continue
+                # head blocked: bounded look-ahead past it
+                took = None
+                if can_admit is not None and window > 0:
+                    for j in range(1, min(window, len(self._queue) - 1) + 1):
+                        if can_admit(self._queue[j]):
+                            took = j
+                            break
+                if took is None:
+                    break
+                cand = self._queue[took]
+                del self._queue[took]
+                out.append(cand)
+                self.reordered_admits += 1
             if not self._queue:
                 self._work.clear()
         if _tracing.enabled() and out:

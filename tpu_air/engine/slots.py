@@ -28,12 +28,17 @@ from .types import Request
 
 @dataclass
 class Slot:
-    """Host bookkeeping for one slab row."""
+    """Host bookkeeping for one slab row (or paged block-table row)."""
 
     index: int
     request: Optional[Request] = None
     pos: int = 0            # cache write position == tokens in context
     budget_left: int = 0    # decode steps remaining before forced retirement
+    # paged engine only: mid-chunked-prefill flag + the pool's AdmitPlan
+    # (remaining chunk starts, prefix coverage).  A prefilling slot holds
+    # pages and a request but does NOT ride the decode step yet.
+    prefilling: bool = False
+    plan: Any = None
 
     @property
     def active(self) -> bool:
@@ -68,6 +73,8 @@ class SlotManager:
         slot.request = None
         slot.pos = 0
         slot.budget_left = 0
+        slot.prefilling = False
+        slot.plan = None
         # keep the free list sorted descending so the next acquire still
         # hands out the lowest free row
         self._free.append(slot.index)

@@ -1,0 +1,286 @@
+"""Windowed continuous decoding for the T5 family.
+
+The PR 1 engine entry points for T5 (models/t5/generate.py:
+``make_t5_prefill_fn`` / ``make_t5_decode_step_fn``) are BATCH-
+SYNCHRONIZED: the decode cache carries one scalar cache index and the
+whole batch's cross-attention K/V, so rows cannot sit at different decode
+positions the way the causal-LM slot pool allows.  :class:`T5Engine` is
+therefore a WINDOW engine, honest about that boundary:
+
+* requests queue through the same :class:`~tpu_air.engine.scheduler.
+  Scheduler` (backpressure, FIFO) and stream back per-token on the same
+  :class:`~tpu_air.engine.types.ResponseStream`;
+* a *window* is one prefill (encode + cache build + first token) over up
+  to ``max_batch`` queued requests padded to a fixed shape, followed by
+  per-token decode steps driven between host visits — tokens stream out
+  as they are decoded, rows retire individually on EOS (inclusive) or
+  budget;
+* ADMISSION happens only at window boundaries: a window must fully drain
+  before the next batch starts (the cross-attn K/V of a retired row
+  cannot be swapped out under the scalar index).  Early-retired rows ride
+  along as dead weight until the window closes — exactly the cost the
+  causal-LM slot engine exists to avoid; per-slot cross-attn slabs remain
+  the open item before T5 can join the slot pool (ROADMAP).
+
+Greedy by construction: token streams are identical to offline T5
+``generate`` with ``early_stop=True`` on the same window batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from tpu_air.models.t5.generate import (
+    make_t5_decode_step_fn,
+    make_t5_prefill_fn,
+)
+
+from .metrics import EngineMetrics, unregister
+from .scheduler import Scheduler
+from .types import (
+    EngineClosedError,
+    EngineOverloadedError,
+    Request,
+    ResponseStream,
+)
+
+
+@dataclass
+class T5EngineConfig:
+    """Dials for the T5 window engine.
+
+    * ``max_batch`` — rows per window (the fixed prefill/decode batch
+      shape; short windows pad with dead all-pad rows).
+    * ``max_input_len`` — encoder-side prompt cap; prompts right-pad to
+      this fixed length so one compiled prefill serves every window.
+    * ``max_new_tokens`` — decode budget cap per request (the cache is
+      sized to it).
+    * ``max_queue`` — queued request cap; beyond it ``submit`` raises
+      :class:`EngineOverloadedError`.
+    """
+
+    max_batch: int = 4
+    max_input_len: int = 64
+    max_new_tokens: int = 32
+    max_queue: int = 256
+    reorder_window: int = 0  # window admission is FIFO; kept for Scheduler
+
+
+class _Window:
+    """One in-flight batch: device cache + per-row host bookkeeping."""
+
+    def __init__(self, requests: List[Request], cache, enc, enc_mask):
+        self.requests: List[Optional[Request]] = list(requests)
+        self.cache = cache
+        self.enc = enc
+        self.enc_mask = enc_mask
+        self.cur_tok = np.zeros((enc_mask.shape[0],), np.int32)
+        self.budget_left = np.zeros((enc_mask.shape[0],), np.int64)
+
+    def live_rows(self):
+        return [i for i, r in enumerate(self.requests) if r is not None]
+
+
+class T5Engine:
+    """Window-level continuous decoding over a T5 model (see module doc)."""
+
+    def __init__(self, model, params, config: Optional[T5EngineConfig] = None,
+                 *, auto_start: bool = True, name: str = "t5-engine"):
+        self.model = model
+        self.params = params
+        self.config = config or T5EngineConfig()
+        self.name = name
+        self.eos_token_id = model.config.eos_token_id
+        self.pad_token_id = model.config.pad_token_id
+
+        cfg = self.config
+        self._prefill = make_t5_prefill_fn(model, cfg.max_new_tokens + 1)
+        self._decode_step = make_t5_decode_step_fn(model)
+        self._window: Optional[_Window] = None
+
+        self.scheduler = Scheduler(cfg)
+        self.metrics = EngineMetrics(name=name, num_slots=cfg.max_batch)
+
+        self._next_request_id = 0
+        self._id_lock = threading.Lock()
+        self._step_lock = threading.Lock()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if auto_start:
+            self.start()
+
+    # -- submission (any thread) ---------------------------------------------
+    def submit(self, input_ids: Sequence[int],
+               max_new_tokens: Optional[int] = None) -> ResponseStream:
+        """Queue one encoder prompt; returns its token stream immediately."""
+        if self._closed:
+            raise EngineClosedError("engine is shut down")
+        prompt = [int(t) for t in input_ids]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.config.max_input_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) exceeds max_input_len "
+                f"({self.config.max_input_len})"
+            )
+        budget = (self.config.max_new_tokens if max_new_tokens is None
+                  else int(max_new_tokens))
+        if not 1 <= budget <= self.config.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens must be in [1, "
+                f"{self.config.max_new_tokens}], got {budget}"
+            )
+        with self._id_lock:
+            rid = self._next_request_id
+            self._next_request_id += 1
+        stream = ResponseStream(rid)
+        req = Request(request_id=rid, prompt=prompt, max_new_tokens=budget,
+                      stream=stream)
+        try:
+            self.scheduler.submit(req)
+        except EngineOverloadedError:
+            self.metrics.record_reject()
+            raise
+        self.metrics.record_submit()
+        return stream
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = 120.0) -> List[List[int]]:
+        """Blocking convenience: submit every prompt, join every stream.
+        In manual mode (no background thread) it drives :meth:`step`."""
+        streams = [self.submit(p, max_new_tokens) for p in prompts]
+        if self._thread is None:
+            while not self.idle():
+                self.step()
+        return [s.result(timeout) for s in streams]
+
+    # -- the engine loop -----------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration: open a window if none is in flight (one
+        prefill over the queued batch), else one decode step.  Returns True
+        if any work happened."""
+        with self._step_lock:
+            worked = False
+            if self._window is None:
+                worked = self._open_window()
+            elif self._window is not None:
+                self._decode_window()
+                worked = True
+            occ = len(self._window.live_rows()) if self._window else 0
+            self.metrics.observe_gauges(self.scheduler.depth(), occ)
+            return worked
+
+    def idle(self) -> bool:
+        return self.scheduler.depth() == 0 and self._window is None
+
+    def _open_window(self) -> bool:
+        reqs = self.scheduler.pop_admissible(self.config.max_batch)
+        if not reqs:
+            return False
+        cfg = self.config
+        b, li = cfg.max_batch, cfg.max_input_len
+        ids = np.full((b, li), self.pad_token_id, np.int32)
+        mask = np.zeros((b, li), np.int32)
+        for row, req in enumerate(reqs):
+            ids[row, :len(req.prompt)] = req.prompt
+            mask[row, :len(req.prompt)] = 1
+        # rows past len(reqs) are dead filler: all-pad, zero mask — their
+        # decode outputs are discarded host-side
+        tok, cache, enc = self._prefill(
+            self.params, jnp.asarray(ids), jnp.asarray(mask))
+        tok = np.asarray(tok)
+        rows: List[Optional[Request]] = list(reqs) + [None] * (b - len(reqs))
+        win = _Window(rows, cache, enc, mask)
+        now = time.monotonic()
+        emitted = 0
+        for row, req in enumerate(reqs):
+            first = int(tok[row])
+            req.first_token_at = now
+            self.metrics.record_ttft(now - req.submitted_at)
+            req.stream._emit(first)
+            emitted += 1
+            win.cur_tok[row] = first
+            win.budget_left[row] = req.max_new_tokens - 1
+            if win.budget_left[row] == 0 or first == self.eos_token_id:
+                self._retire(win, row)
+        self.metrics.record_tokens(emitted)
+        self._window = win if win.live_rows() else None
+        return True
+
+    def _decode_window(self) -> None:
+        win = self._window
+        t0 = time.monotonic()
+        win.cache, nxt = self._decode_step(
+            self.params, win.cache, jnp.asarray(win.cur_tok), win.enc,
+            jnp.asarray(win.enc_mask),
+        )
+        nxt = np.asarray(nxt)
+        dt = time.monotonic() - t0
+        emitted = 0
+        for row in win.live_rows():
+            # airlint: disable=JX004 — nxt is the np.asarray'd step result;
+            # the single device sync already happened above the loop
+            token = int(nxt[row])
+            req = win.requests[row]
+            req.stream._emit(token)
+            emitted += 1
+            win.cur_tok[row] = token
+            win.budget_left[row] -= 1
+            if win.budget_left[row] == 0 or token == self.eos_token_id:
+                self._retire(win, row)
+        self.metrics.record_step(dt, emitted)
+        if not win.live_rows():
+            # window drained: drop its cache, admit the next batch on the
+            # following step
+            self._window = None
+
+    def _retire(self, win: _Window, row: int) -> None:
+        win.requests[row].stream._finish()
+        win.requests[row] = None
+        self.metrics.record_complete()
+
+    # -- background loop / lifecycle -----------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name=f"tpu-air-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._closed:
+            if not self.step():
+                self.scheduler.wait_for_work(0.01)
+
+    def close(self) -> None:
+        """Stop the loop; fail queued and in-flight requests loudly."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        with self._step_lock:
+            err = EngineClosedError("engine shut down")
+            for req in self.scheduler.drain():
+                req.stream._finish(err)
+            if self._window is not None:
+                for row in self._window.live_rows():
+                    self._window.requests[row].stream._finish(err)
+                self._window = None
+        unregister(self.name)
+
+    def __enter__(self) -> "T5Engine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -29,21 +29,42 @@ class EngineClosedError(TpuAirError):
 
 @dataclass
 class EngineConfig:
-    """Dials for the slot pool and admission policy.
+    """Dials for the KV pool and admission policy.
 
     * ``num_slots`` — S, the fixed decode batch width.  One persistent
       compiled step serves the whole engine lifetime; a slot is one
       in-flight sequence.
-    * ``slot_len`` — L, positions per slot (the flat KV slab is
-      ``[S, L, h*d]`` per layer).  Admission requires
+    * ``slot_len`` — L, max positions per sequence.  Admission requires
       ``len(prompt) + max_new_tokens <= slot_len``.
     * ``max_new_tokens`` — default per-request decode budget.
     * ``max_queue`` — queued (not yet admitted) request cap; beyond it
       ``submit`` raises :class:`EngineOverloadedError`.
-    * ``prefill_buckets`` — prompt-length buckets (ascending).  Prompts are
-      right-padded to the smallest fitting bucket so prefill compiles once
-      per bucket, not once per length.  ``None`` → powers of two up to
-      ``slot_len``.
+    * ``kv_mode`` — ``"paged"`` (default): block-table-paged KV pool with
+      prefix sharing and chunked prefill (``tpu_air/engine/kvpool/``);
+      ``"slab"``: the PR 1 fixed per-slot slabs ``[S, slot_len, h*d]``
+      (kept as the bench baseline and the mode the T5 window engine uses).
+    * ``page_len`` — paged mode: positions per KV page.  Multiples of 8
+      keep every page whole (8, 128) TPU tiles in the flat ``h*d`` layout.
+    * ``num_pages`` — paged mode: physical pages in the pool (page 0 is
+      the pinned null page).  ``None`` → slab-equivalent capacity,
+      ``num_slots * ceil(slot_len / page_len) + 1`` — same HBM as the
+      slab pool; prefix sharing turns the saved pages into headroom.
+    * ``prefix_cache`` — paged mode: keep retired prompts' pages resident
+      (radix over page chunks) so later prompts sharing a prefix skip
+      that prefill and share the physical pages.
+    * ``prefill_chunks_per_step`` — paged mode: prefill chunks run per
+      engine step, interleaved between pool decode steps.  1 (default)
+      bounds how long any prefill work can delay in-flight decodes, so a
+      long prompt streams in page-sized pieces while short requests keep
+      decoding (flat TTFT under long-prompt arrival).
+    * ``reorder_window`` — admission may look this many queue entries past
+      a request that does not currently fit (no free KV pages) and admit
+      later ones that do.  0 restores strict FIFO.
+    * ``prefill_buckets`` — slab mode: prompt-length buckets (ascending);
+      prompts right-pad to the smallest fitting bucket so prefill
+      compiles once per bucket.  ``None`` → powers of two up to
+      ``slot_len``.  Paged mode needs no buckets: every prompt length
+      runs through one compiled page-sized chunk program.
     * ``eos_token_id`` — ``"model"`` (default): use the model config's
       ``eos_token_id``; ``None``: never early-stop (budget-only
       retirement); an int: that id.
@@ -53,8 +74,22 @@ class EngineConfig:
     slot_len: int = 256
     max_new_tokens: int = 64
     max_queue: int = 256
+    kv_mode: str = "paged"
+    page_len: int = 16
+    num_pages: Optional[int] = None
+    prefix_cache: bool = True
+    prefill_chunks_per_step: int = 1
+    reorder_window: int = 4
     prefill_buckets: Optional[Tuple[int, ...]] = None
     eos_token_id: Union[int, None, str] = "model"
+
+    def pages_per_slot(self) -> int:
+        return -(-self.slot_len // self.page_len)
+
+    def pool_pages(self) -> int:
+        if self.num_pages is not None:
+            return self.num_pages
+        return self.num_slots * self.pages_per_slot() + 1
 
     def buckets(self) -> Tuple[int, ...]:
         if self.prefill_buckets is not None:

@@ -175,18 +175,22 @@ def make_lm_generate_fn(model: CausalLM, max_new_tokens: int,
 # ---------------------------------------------------------------------------
 
 
-def _map_cache_index(cache, fn):
-    """Rebuild a flax cache dict with ``fn`` applied to every cache_index
-    leaf (slabs pass through untouched)."""
+def _map_cache_leaf(cache, leaf, fn):
+    """Rebuild a flax cache dict with ``fn`` applied to every ``leaf``-named
+    entry (everything else passes through untouched)."""
     out = {}
     for k, v in cache.items():
         if isinstance(v, dict):
-            out[k] = _map_cache_index(v, fn)
-        elif k == "cache_index":
+            out[k] = _map_cache_leaf(v, leaf, fn)
+        elif k == leaf:
             out[k] = fn(v)
         else:
             out[k] = v
     return out
+
+
+def _map_cache_index(cache, fn):
+    return _map_cache_leaf(cache, "cache_index", fn)
 
 
 def init_slot_cache(model: CausalLM, num_slots: int, slot_len: int):
@@ -271,6 +275,169 @@ def make_lm_decode_step_fn(model: CausalLM, slot_len: int):
         return vars_["cache"], nxt
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# Paged engine entry points (tpu_air.engine.kvpool)
+#
+# Same phases as the slab entry points above, over the paged cache layout:
+# per-layer page POOLS [num_pages, page_len, h*d] shared by all slots plus a
+# block_table leaf [S, pages_per_slot] mapping each slot's logical positions
+# onto physical pages.  The table and per-slot indices are HOST state
+# (engine/kvpool/pool.py) pushed into the cache dict at every call via leaf
+# mappers, so the donated device cache never round-trips.  Prefill is
+# page-sized CHUNKS — one compiled program for every prompt length — instead
+# of the slab path's per-bucket compiles.
+# ---------------------------------------------------------------------------
+
+
+def init_paged_cache(model: CausalLM, num_slots: int, num_pages: int,
+                     page_len: int, pages_per_slot: int):
+    """Zero paged KV cache: every attention layer gets page pools
+    ``[num_pages, page_len, h*d]`` (page 0 = the pinned null page), a
+    per-slot index vector ``[S]`` and a block table ``[S, pages_per_slot]``
+    of page ids (0 = unreached/null).  This is the persistent donated cache
+    of a paged engine."""
+    base = init_slot_cache(model, num_slots, page_len)
+
+    def rebuild(d):
+        out = {}
+        for k, v in d.items():
+            if not isinstance(v, dict):
+                out[k] = v
+            elif "cached_key" in v:
+                hd = v["cached_key"].shape[-1]
+                dt = v["cached_key"].dtype
+                out[k] = {
+                    "cached_key": jnp.zeros((num_pages, page_len, hd), dt),
+                    "cached_value": jnp.zeros((num_pages, page_len, hd), dt),
+                    "cache_index": jnp.zeros((num_slots,), jnp.int32),
+                    "block_table": jnp.zeros(
+                        (num_slots, pages_per_slot), jnp.int32),
+                }
+            else:
+                out[k] = rebuild(v)
+        return out
+
+    return rebuild(base)
+
+
+def make_lm_paged_decode_step_fn(model: CausalLM, slot_len: int):
+    """The persistent paged engine step: jitted ``fn(params, cache, tok,
+    pos, block_table) -> (cache', next_tok)``, cache donated.  Identical
+    contract to :func:`make_lm_decode_step_fn` plus the block table
+    ``[S, pages_per_slot]`` int32 (the host pool's authoritative table —
+    rows of non-decoding slots pointed at the null page so their ride-along
+    scatter can't touch a live or prefix-shared page)."""
+    cfg = model.config
+    dcfg = {**cfg.to_dict(), "max_seq_len": slot_len}
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def step(params, cache, tok, pos, block_table):
+        dmodel = CausalLM(LMConfig.from_dict(dcfg))
+        pos = pos.astype(jnp.int32)
+        cache = _map_cache_index(cache, lambda _: pos)
+        cache = _map_cache_leaf(
+            cache, "block_table",
+            lambda _: block_table.astype(jnp.int32))
+        hidden, vars_ = dmodel.apply(
+            {"params": params, "cache": cache}, tok[:, None], pos[:, None],
+            decode=True, return_hidden=True, mutable=["cache"],
+        )
+        head_w = head_weight(params, cfg).astype(jnp.float32)
+        nxt = jnp.argmax(
+            hidden[:, -1].astype(jnp.float32) @ head_w, axis=-1
+        ).astype(jnp.int32)
+        return vars_["cache"], nxt
+
+    return step
+
+
+def make_lm_prefill_chunk_fn(model: CausalLM, page_len: int, slot_len: int):
+    """Build THE chunked-prefill unit: a jitted ``fn(params, cache, ids,
+    p0, last_local, table_row) -> (cache', tok)``, cache donated.
+
+    One call processes ONE page-sized chunk of ONE slot's prompt:
+
+    * ``ids`` ``[1, page_len]`` — the chunk's tokens, right-padded on the
+      final (partial) chunk.  Pad positions write don't-care K/V into the
+      page tail; the per-slot validity mask hides them until decode
+      appends overwrite them — the slab engine's stale-bytes discipline.
+    * ``p0`` — the chunk's first global position (page-aligned).
+    * ``last_local`` — index of the prompt's last real token WITHIN this
+      chunk, valid only on the final chunk; the returned greedy first
+      token is read there (intermediate chunks' tok is discarded).
+    * ``table_row`` ``[pages_per_slot]`` — the slot's block-table row (the
+      pool may substitute the null page for a fully-prefix-covered
+      prompt's re-run tail chunk: PagedKVPool.chunk_row).
+
+    Fixed shapes -> ONE compiled program covers every prompt length; the
+    engine interleaves these calls between decode steps so long prompts
+    stream in without stalling in-flight decodes."""
+    cfg = model.config
+    dcfg = {**cfg.to_dict(), "max_seq_len": slot_len}
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def prefill_chunk(params, cache, ids, p0, last_local, table_row):
+        dmodel = CausalLM(LMConfig.from_dict(dcfg))
+        p0 = p0.astype(jnp.int32)
+        # leaf shapes must stay [S]/[S, npg] across chunk and decode calls
+        # (shape-stable donation); only row 0 is consulted at b=1
+        cache = _map_cache_index(
+            cache, lambda v: jnp.full(v.shape, p0, jnp.int32))
+        cache = _map_cache_leaf(
+            cache, "block_table",
+            lambda v: jnp.broadcast_to(
+                table_row.astype(jnp.int32)[None], v.shape))
+        positions = (p0 + jnp.arange(page_len, dtype=jnp.int32))[None]
+        hidden, vars_ = dmodel.apply(
+            {"params": params, "cache": cache}, ids, positions,
+            decode=True, return_hidden=True, mutable=["cache"],
+        )
+        head_w = head_weight(params, cfg).astype(jnp.float32)
+        h_last = hidden[0, last_local.astype(jnp.int32)]
+        tok = jnp.argmax(
+            h_last.astype(jnp.float32) @ head_w
+        ).astype(jnp.int32)
+        return vars_["cache"], tok
+
+    return prefill_chunk
+
+
+def make_page_copy_fn():
+    """Build the copy-on-write primitive: a jitted ``fn(cache, dst, src) ->
+    cache'`` (cache donated) copying page ``src`` onto page ``dst`` in every
+    layer's K and V pools.  Run once when a slot's first decode append would
+    land in a prefix-shared tail page (PagedKVPool.resolve_cow); index and
+    table leaves pass through untouched."""
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def copy_page(cache, dst, src):
+        dst = dst.astype(jnp.int32) if hasattr(dst, "astype") else dst
+        src = src.astype(jnp.int32) if hasattr(src, "astype") else src
+
+        def walk(d):
+            out = {}
+            for k, v in d.items():
+                if isinstance(v, dict):
+                    out[k] = walk(v)
+                elif k in ("cached_key", "cached_value"):
+                    page = jax.lax.dynamic_slice(
+                        v, (src, 0, 0), (1,) + v.shape[1:])
+                    out[k] = jax.lax.dynamic_update_slice(
+                        v, page, (dst, 0, 0))
+                else:
+                    out[k] = v
+            return out
+
+        return walk(cache)
+
+    return copy_page
 
 
 _GEN_CACHE: Dict[Tuple, Any] = {}

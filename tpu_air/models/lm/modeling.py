@@ -111,6 +111,69 @@ class CausalSelfAttention(nn.Module):
             i = idx.value
             kflat = k.transpose(0, 2, 1, 3).reshape(b, l, h * d)
             vflat = v.transpose(0, 2, 1, 3).reshape(b, l, h * d)
+            if self.has_variable("cache", "block_table"):
+                # PAGED engine cache (engine/kvpool/): cached_key/value are
+                # page POOLS [P, page_len, h*d] shared by every slot, and
+                # block_table [S, pages_per_slot] maps each slot's logical
+                # positions onto physical pages — position p of slot s lives
+                # at (table[s, p // C], p % C).  Prefix-shared pages appear
+                # in several rows at once; the null page (id 0) absorbs
+                # writes/reads of masked rows and unreached entries.
+                from tpu_air.ops.decode_attention import (
+                    flat_decode_attention, gather_pages)
+
+                bt = self.variable(
+                    "cache", "block_table",
+                    lambda: jnp.zeros((b, 1), jnp.int32))
+                table = bt.value
+                npg = table.shape[1]
+                C = ck.value.shape[1]
+                lg = npg * C
+                if l == 1:
+                    # paged decode step: scatter each slot's new K/V to its
+                    # current (page, offset), then attend over the gathered
+                    # flat slab — same r5 formulation, pool-resident pages.
+                    rows = jnp.arange(b)
+                    page = table[rows, i // C]
+                    off = i % C
+                    ck.value = ck.value.at[page, off].set(
+                        kflat[:, 0].astype(dtype))
+                    cv.value = cv.value.at[page, off].set(
+                        vflat[:, 0].astype(dtype))
+                    idx.value = i + 1
+                    kvm = jnp.arange(lg)[None, :] <= i[:, None]
+                    o4 = flat_decode_attention(
+                        q.transpose(0, 2, 1, 3) * scale,
+                        gather_pages(ck.value, table),
+                        gather_pages(cv.value, table),
+                        None, kvm, None, None, h, dtype)
+                    return proj("o", cfg.d_model)(o4.reshape(b, 1, h * d))
+                # chunked prefill: ONE slot (b == 1) processes one page-
+                # aligned chunk of its prompt at positions p0 .. p0+l-1.
+                # The whole chunk writes its page in one dynamic_update_
+                # slice; attention runs dense over the gathered pages with
+                # the query offset at p0 (earlier chunks / prefix-shared
+                # pages supply 0 .. p0-1).  One compiled program serves
+                # EVERY prompt length — no per-bucket prefill compiles.
+                if b != 1 or l != C:
+                    raise ValueError(
+                        f"paged chunk prefill wants b=1, l=page_len ({C}); "
+                        f"got b={b}, l={l}"
+                    )
+                p0 = i[0]
+                page = table[0, p0 // C]
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, kflat.astype(dtype), (page, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, vflat.astype(dtype), (page, 0, 0))
+                idx.value = i + l
+                kg = gather_pages(ck.value, table[:1])
+                vg = gather_pages(cv.value, table[:1])
+                k4 = kg.reshape(1, lg, h, d).transpose(0, 2, 1, 3)
+                v4 = vg.reshape(1, lg, h, d).transpose(0, 2, 1, 3)
+                o = _dense_causal_attention(q, k4, v4, scale, q_offset=p0)
+                o = o.transpose(0, 2, 1, 3).reshape(b, l, h * d)
+                return proj("o", cfg.d_model)(o)
             if i.ndim == 1:
                 # PER-ROW cache index [b] (the continuous-batching engine,
                 # engine/engine.py): every slot sits at its own position, so

@@ -286,6 +286,27 @@ def decode_attention_reference(q, k, v, *, bias=None, kv_mask=None,
     return out[:, None] if squeeze else out
 
 
+def gather_pages(pool: jax.Array, block_table: jax.Array) -> jax.Array:
+    """Assemble per-slot flat K/V slabs from a paged pool.
+
+    ``pool`` ``[P, page_len, h*d]`` — the engine's physical KV pages (page 0
+    is the pinned null page); ``block_table`` ``[S, pages_per_slot]`` int32 —
+    each slot's logical pages in position order.  Returns
+    ``[S, pages_per_slot * page_len, h*d]``: position ``p`` of slot ``s``
+    lives at ``(block_table[s, p // page_len], p % page_len)``, so the
+    gathered result is exactly the flat slab :func:`flat_decode_attention`
+    consumes — the paged pool changes WHERE pages live, not the layout
+    attention streams.  Pages keep the ``[*, page_len, h*d]`` last-two-dims
+    contract from the r5 roofline study: with ``page_len`` a multiple of 8
+    and h*d a multiple of 128 every page is whole (8, 128) f32 tiles, so
+    paging adds zero tile padding over the slab layout it replaces.
+    Entries pointing at the null page gather don't-care bytes that the
+    caller's validity mask (``position <= cache_index``) hides."""
+    s, npg = block_table.shape
+    _, page_len, hd = pool.shape
+    return pool[block_table].reshape(s, npg * page_len, hd)
+
+
 def flat_decode_attention(q, kf, vf, bias_hl, kv_mask, k_scale, v_scale,
                            num_heads, dtype):
     """Single-token attention over FLAT cache slabs ``[b, L, h*d]`` —

@@ -1,8 +1,10 @@
 """EngineDeployment — serve the continuous-batching engine over HTTP.
 
-Each replica actor owns one :class:`tpu_air.engine.InferenceEngine` (slot
-pool + persistent decode step + background loop) built from a Checkpoint.
-Two client surfaces:
+Each replica actor owns one engine built from a Checkpoint — a
+:class:`tpu_air.engine.InferenceEngine` (slot/page pool + persistent decode
+step + background loop), or a :class:`tpu_air.engine.T5Engine` when the
+``engine_config`` is a :class:`~tpu_air.engine.T5EngineConfig` (the config
+type selects the engine family).  Two client surfaces:
 
 * blocking HTTP: ``POST {"prompts": [[ids...], ...], "max_new_tokens": n}``
   → ``{"results": [{"request_id": ..., "tokens": [...]}, ...]}`` — every
@@ -53,7 +55,12 @@ class _EngineServer:
     def _ensure_engine(self):
         if self._engine is None:
             # lazy import: the serve package must stay importable without jax
-            from tpu_air.engine import EngineConfig, InferenceEngine
+            from tpu_air.engine import (
+                EngineConfig,
+                InferenceEngine,
+                T5Engine,
+                T5EngineConfig,
+            )
 
             model, params = self._checkpoint.get_model(dtype=self._dtype)
             if self._dtype:
@@ -65,10 +72,19 @@ class _EngineServer:
                                if hasattr(x, "astype") else x),
                     params,
                 )
-            self._engine = InferenceEngine(
-                model, params, self._engine_config or EngineConfig(),
-                name=self._engine_name,
-            )
+            # the config type picks the engine family: a T5EngineConfig
+            # gets the window engine (batch-synchronized T5 decode), any
+            # EngineConfig (or None) the causal-LM slot/page engine
+            if isinstance(self._engine_config, T5EngineConfig):
+                self._engine = T5Engine(
+                    model, params, self._engine_config,
+                    name=self._engine_name,
+                )
+            else:
+                self._engine = InferenceEngine(
+                    model, params, self._engine_config or EngineConfig(),
+                    name=self._engine_name,
+                )
         return self._engine
 
     # -- blocking HTTP path ---------------------------------------------------
