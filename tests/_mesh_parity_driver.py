@@ -1,0 +1,89 @@
+"""Subprocess driver: MeshEngine (dp=2, tp=2) token parity on a forced
+8-device CPU host (tests/test_dist_engine.py runs this; the
+tests/_multihost_driver.py pattern).
+
+Re-executed jax-clean so the forced device count binds before jax does:
+the parent test pops every TPU_AIR_*/coordinator variable and this driver
+pins its own XLA_FLAGS.  Prints MESH-PARITY-OK on success.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import random
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_air.engine import EngineConfig, InferenceEngine, MeshEngine
+    from tpu_air.models.lm import CausalLM, LMConfig
+    from tpu_air.models.lm.generate import generate
+
+    assert len(jax.devices()) == 8, jax.devices()
+
+    cfg = LMConfig.tiny()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    eos = cfg.eos_token_id
+    max_new = 8
+
+    rng = random.Random(23)
+    prompts = [[rng.randrange(1, 384) for _ in range(rng.randrange(3, 12))]
+               for _ in range(6)]
+    prompts.append(prompts[0] + [5, 11])  # shared-prefix arrival
+
+    def offline(p):
+        out = np.asarray(
+            generate(model, params, [p], max_new_tokens=max_new,
+                     eos_token_id=eos))[0].tolist()
+        if eos is not None and eos in out:
+            out = out[: out.index(eos) + 1]
+        return out
+
+    want = [offline(p) for p in prompts]
+
+    def drain(engine, streams):
+        steps = 0
+        while not engine.idle():
+            engine.step()
+            steps += 1
+            assert steps < 500, "engine failed to drain"
+        return [s.result(5.0) for s in streams]
+
+    ecfg = EngineConfig(num_slots=4, slot_len=64, max_new_tokens=max_new,
+                        page_len=8)
+
+    single = InferenceEngine(model, params, ecfg, auto_start=False,
+                             name="mesh-parity-single")
+    got_single = drain(single, [single.submit(p) for p in prompts])
+    single.close()
+    assert got_single == want, f"single-chip mismatch\n{want}\n{got_single}"
+
+    for dp, tp in ((2, 2), (4, 2), (1, 8)):
+        eng = MeshEngine(model, params, ecfg, dp=dp, tp=tp,
+                         auto_start=False, name=f"mesh-parity-{dp}x{tp}")
+        got = drain(eng, [eng.submit(p) for p in prompts])
+        topo = eng.metrics.snapshot()["topology"]
+        eng.close()
+        assert got == want, f"mesh {dp}x{tp} mismatch\n{want}\n{got}"
+        assert topo["mesh"] == f"{dp}x{tp}" and topo["lease"] == "local"
+        print(f"MESH-{dp}x{tp}-OK")
+
+    print("MESH-PARITY-OK")
+
+
+if __name__ == "__main__":
+    main()

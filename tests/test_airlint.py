@@ -245,6 +245,93 @@ class TestJX004HostSyncInHotPath:
         assert_quiet(src, "JX004")
 
 
+class TestJX005CollectiveOutsideMappedContext:
+    VIOLATION = """\
+        import jax
+
+        def grad_sync(grads):
+            return jax.lax.psum(grads, "data")
+        """
+
+    # every quiet shape airlint must tolerate mirrors real repo code:
+    # ring_attention.py (partial handed to shard_map_unchecked),
+    # sequence_parallel.py (aliased wrapper + helper called from the mapped
+    # fn), lm_trainer.py (jit over shard_map)
+    CLEAN = """\
+        import functools
+        import jax
+        from compat import shard_map_unchecked as _shard_map
+        from jax.experimental.shard_map import shard_map
+
+        def helper(x):
+            return jax.lax.axis_index("sequence") * x
+
+        def local_step(params, x):
+            y = helper(x)
+            return jax.lax.psum(y, ("data", "sequence"))
+
+        step = jax.jit(_shard_map(local_step, mesh=None,
+                                  in_specs=None, out_specs=None))
+
+        def ring(q, axis_name):
+            return jax.lax.ppermute(q, axis_name, [(0, 1)])
+
+        body = functools.partial(ring, axis_name="sequence")
+        attn = shard_map(body, mesh=None, in_specs=None, out_specs=None)
+
+        g = shard_map(lambda x: jax.lax.psum(x, "i"), mesh=None,
+                      in_specs=None, out_specs=None)
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "JX005", 'jax.lax.psum(grads, "data")')
+        assert f.severity == Severity.WARNING
+        assert "unbound axis" in f.message
+
+    def test_clean_twin(self):
+        assert_quiet(self.CLEAN, "JX005")
+
+    def test_bare_lax_import_fires(self):
+        src = """\
+            from jax.lax import all_gather
+
+            def gather(x):
+                return all_gather(x, "model")
+            """
+        f = assert_fires(src, "JX005", 'all_gather(x, "model")')
+        assert "all_gather" in f.message
+
+    def test_module_scope_fires(self):
+        src = """\
+            import jax
+
+            idx = jax.lax.axis_index("data")
+            """
+        f = assert_fires(src, "JX005", 'jax.lax.axis_index("data")')
+        assert "module scope" in f.message
+
+    def test_axisless_reduction_not_flagged(self):
+        # jnp-style reductions and axis-free lax calls carry no axis name
+        src = """\
+            import jax
+
+            def total(x):
+                return jax.lax.psum(x)
+            """
+        assert_quiet(src, "JX005")
+
+    def test_pmap_decorator_registers(self):
+        src = """\
+            import functools
+            import jax
+
+            @functools.partial(jax.pmap, axis_name="batch")
+            def step(x):
+                return jax.lax.pmean(x, "batch")
+            """
+        assert_quiet(src, "JX005")
+
+
 class TestRT001BlockingInActor:
     VIOLATION = """\
         import time
@@ -409,7 +496,7 @@ class TestAL000ParseError:
 
 def test_every_rule_has_a_fixture():
     """Adding a rule without a fires+quiet fixture pair must fail CI."""
-    covered = {"JX001", "JX002", "JX003", "JX004",
+    covered = {"JX001", "JX002", "JX003", "JX004", "JX005",
                "RT001", "RT002", "RT003", "RT004"}
     assert {r.id for r in all_rules()} == covered
 

@@ -305,25 +305,39 @@ def test_scheduler_reorder_window_zero_is_strict_fifo():
 
 def test_paged_engine_matches_offline_and_slab(lm):
     """The ISSUE acceptance anchor: the paged engine is token-identical to
-    offline greedy generate — and to the slab engine — on the same burst."""
+    offline greedy generate — and to the slab engine and the sharded
+    MeshEngine (dp=2, tp=2 over the forced-8-device CPU host) — on the
+    same burst."""
+    from tpu_air.engine import MeshEngine
+
     cfg, model, params = lm
     prompts = _prompts(seed=21, n=6)
     max_new = 8
     outs = {}
-    for mode in ("paged", "slab"):
-        engine = InferenceEngine(
-            model, params,
-            EngineConfig(num_slots=3, slot_len=64, max_new_tokens=max_new,
-                         kv_mode=mode, page_len=8),
-            auto_start=False, name=f"kvpool-parity-{mode}",
-        )
+    for mode in ("paged", "slab", "mesh"):
+        if mode == "mesh":
+            if len(jax.devices()) < 4:
+                continue  # rig needs the conftest's forced device count
+            engine = MeshEngine(
+                model, params,
+                EngineConfig(num_slots=4, slot_len=64,
+                             max_new_tokens=max_new, page_len=8),
+                dp=2, tp=2, auto_start=False, name="kvpool-parity-mesh",
+            )
+        else:
+            engine = InferenceEngine(
+                model, params,
+                EngineConfig(num_slots=3, slot_len=64, max_new_tokens=max_new,
+                             kv_mode=mode, page_len=8),
+                auto_start=False, name=f"kvpool-parity-{mode}",
+            )
         streams = [engine.submit(p) for p in prompts]
         _drain(engine)
         outs[mode] = [s.result(5.0) for s in streams]
         engine.close()
     want = [_offline(model, params, p, max_new) for p in prompts]
-    assert outs["paged"] == want
-    assert outs["slab"] == want
+    for mode, got in outs.items():
+        assert got == want, f"{mode} diverged from offline"
 
 
 def test_paged_engine_prefix_hits_and_cow(lm):

@@ -26,8 +26,8 @@ the speedups are schedule/memory effects, not different outputs.
 Honest CPU caveat: on CPU each jitted call costs ~2-3 ms of fixed
 dispatch+small-compute regardless of size, so the paged engine — which
 replaces one bucketed prefill with several page-sized chunk calls — lands
-a few percent BEHIND the slab engine on wall time here even at a >0.8
-prefix hit rate.  The layout's wins are HBM-side: slab-equivalent page
+only around parity with the slab engine on wall time here (0.9-1.1x
+across runs) even at a >0.8 prefix hit rate.  The layout's wins are HBM-side: slab-equivalent page
 count with shared prefixes turning into admission headroom, and bounded
 per-step prefill stalls.  On TPU (weight-streaming-bound steps, ~µs
 dispatch) the saved prefill FLOPs are the dominant term.
@@ -120,6 +120,15 @@ def main() -> None:
                     help="staggered inter-arrival gap")
     ap.add_argument("--tiny", action="store_true",
                     help="LMConfig.tiny smoke run")
+    ap.add_argument("--mesh", default=None, metavar="DP,TP",
+                    help="also run the trace through a MeshEngine over a "
+                         "dp x tp device mesh (needs dp*tp visible devices; "
+                         "on CPU: XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=N)")
+    ap.add_argument("--disagg", type=int, default=0, metavar="N",
+                    help="also run the trace through a DisaggRouter with N "
+                         "PrefillWorker actor replicas (initializes the "
+                         "tpu_air runtime)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -237,6 +246,67 @@ def main() -> None:
         flat[variant] = round(_pctl(shorts, 0.95), 4)
     paged.close()
 
+    # -- optional distributed paths (engine/dist/): same schedule ------------
+    mesh_block = None
+    if args.mesh:
+        from tpu_air.engine import MeshEngine
+
+        dp, tp = (int(x) for x in args.mesh.split(","))
+        mesh_eng = MeshEngine(
+            model, params,
+            EngineConfig(num_slots=args.num_slots, slot_len=args.slot_len,
+                         max_new_tokens=args.max_new, page_len=args.page_len,
+                         eos_token_id=None,
+                         prefill_chunks_per_step=args.prefill_chunks_per_step),
+            dp=dp, tp=tp, name="engine-bench-mesh")
+        for ln in (short_len, long_len):  # compile both prompt shapes
+            mesh_eng.submit(list(range(1, ln + 1)),
+                            max_new_tokens=8).result(timeout=600)
+        mesh_eng.metrics.reset_window()
+        mesh_wall, mesh_tokens, mesh_ttft = _run_engine_trace(
+            mesh_eng, schedule)
+        mesh_block = {
+            "mesh": f"{dp}x{tp}",
+            "lease": mesh_eng.lease_id,
+            "wall_s": round(mesh_wall, 4),
+            "tokens_per_s": round(mesh_tokens / mesh_wall, 2),
+            **_ttft_stats(mesh_ttft, kinds),
+        }
+        mesh_eng.close()
+
+    disagg_block = None
+    if args.disagg:
+        import tpu_air
+        from tpu_air.engine import DisaggRouter
+        from tpu_air.train import Checkpoint
+
+        tpu_air.init()
+        ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+        router = DisaggRouter(
+            ckpt,
+            EngineConfig(num_slots=args.num_slots, slot_len=args.slot_len,
+                         max_new_tokens=args.max_new, page_len=args.page_len,
+                         eos_token_id=None,
+                         prefill_chunks_per_step=args.prefill_chunks_per_step),
+            prefill_replicas=args.disagg, name="engine-bench-disagg")
+        for ln in (short_len, long_len):  # warm decode + worker prefill jits
+            router.submit(list(range(1, ln + 1)), 8).result(timeout=600)
+        router.engine.metrics.reset_window()
+        dis_wall, dis_tokens, dis_ttft = _run_engine_trace(router, schedule)
+        st = router.stats()
+        disagg_block = {
+            "prefill_replicas": args.disagg,
+            "wall_s": round(dis_wall, 4),
+            "tokens_per_s": round(dis_tokens / dis_wall, 2),
+            **_ttft_stats(dis_ttft, kinds),
+            "handoffs": st["handoffs"],
+            "fallbacks": st["fallbacks"],
+            "kv_bytes_shipped": sum(w.get("bytes_shipped", 0)
+                                    for w in st["workers"]),
+        }
+        router.close()
+        tpu_air.shutdown()
+
     looked = (post["prefix_hits"] - pre["prefix_hits"]) + (
         post["prefix_misses"] - pre["prefix_misses"])
     trace_hits = post["prefix_hits"] - pre["prefix_hits"]
@@ -257,6 +327,8 @@ def main() -> None:
             "prefill_chunks_per_step": args.prefill_chunks_per_step,
             "arrival": f"staggered, {args.gap_s}s gap",
             "platform": jax.devices()[0].platform,
+            "mesh": args.mesh or None,
+            "disagg_prefill_replicas": args.disagg or 0,
         },
         "request_per_call": {
             "wall_s": round(base_wall, 4),
@@ -294,6 +366,10 @@ def main() -> None:
                            / max(flat["short_only"], 1e-9), 3),
         },
     }
+    if mesh_block is not None:
+        result["mesh_engine"] = mesh_block
+    if disagg_block is not None:
+        result["disagg"] = disagg_block
     print(json.dumps(result, indent=2))
     if args.out:
         with open(args.out, "w") as f:

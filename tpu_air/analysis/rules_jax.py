@@ -1,4 +1,4 @@
-"""JAX/TPU hazard rules: JX001–JX004.
+"""JAX/TPU hazard rules: JX001–JX005.
 
 These are heuristics over a single module's AST — no type inference, no
 cross-module dataflow.  They are tuned to catch the classic failure modes
@@ -324,4 +324,165 @@ def jx004_host_sync(ctx: ModuleContext) -> List[Finding]:
                 f"{desc} inside the `{fn.name}` loop forces a host-device "
                 "sync every iteration — batch the transfer outside the "
                 "loop or keep the value on device"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JX005 — collective outside a mapped context
+# ---------------------------------------------------------------------------
+
+# Wrappers that bind (or may bind, cross-module) a named mesh axis.  jit and
+# pjit are accepted because a jitted function is routinely the mapped entry
+# point (``jax.jit(shard_map(f, ...))``) or is invoked from inside one in
+# another module — flagging those would be all false positives.
+_MAPPED_WRAPPERS = {"shard_map", "shard_map_unchecked", "pmap", "xmap",
+                    "jit", "pjit"}
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+                "ppermute", "pshuffle", "psum_scatter", "axis_index"}
+_PARTIAL_BASES = {"partial"}
+
+
+def _import_alias_map(ctx: ModuleContext) -> Dict[str, str]:
+    """{local_name -> original_name} for ``from m import x as y`` — so the
+    ``shard_map_unchecked as _shard_map`` idiom still reads as a wrapper."""
+    out: Dict[str, str] = {}
+    for node in ctx.nodes:
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.asname:
+                    out[alias.asname] = alias.name
+    return out
+
+
+def _lax_imports(ctx: ModuleContext) -> set:
+    """Bare names imported straight out of jax.lax."""
+    out = set()
+    for node in ctx.nodes:
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+            out.update(a.asname or a.name for a in node.names)
+    return out
+
+
+def _base_name(ctx, aliases: Dict[str, str], node: ast.AST) -> Optional[str]:
+    """Last component of a callable's dotted name, alias-resolved."""
+    fname = dotted(node)
+    if fname is None:
+        return None
+    base = fname.rsplit(".", 1)[-1]
+    if "." not in fname and base in aliases:
+        base = aliases[base].rsplit(".", 1)[-1]
+    return base
+
+
+def _wrapped_callees(ctx, aliases, call: ast.Call):
+    """Function names / lambda nodes a wrapper call registers: plain Name
+    args and the target of a ``partial(f, ...)`` arg."""
+    for arg in call.args:
+        if isinstance(arg, ast.Name):
+            yield arg.id
+        elif (isinstance(arg, ast.Call)
+              and _base_name(ctx, aliases, arg.func) in _PARTIAL_BASES
+              and arg.args):
+            name = dotted(arg.args[0])
+            if name is not None:
+                yield name.rsplit(".", 1)[-1]
+
+
+@rule("JX005", "collective-outside-mapped-context", Severity.WARNING,
+      "jax.lax collectives resolve their axis name against an enclosing "
+      "shard_map/pmap; called eagerly they raise NameError: unbound axis")
+def jx005_collective_outside_mapped_context(ctx: ModuleContext) -> List[Finding]:
+    aliases = _import_alias_map(ctx)
+    lax_names = _lax_imports(ctx)
+
+    # 1) names handed to a mapped wrapper (shard_map(f,...), jit(partial(f,..)))
+    #    plus defs carrying a wrapper decorator
+    registered = set()
+    wrapper_calls = []
+    partial_bindings: Dict[str, str] = {}  # body = partial(ring_attention, ..)
+    for node in ctx.nodes:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _base_name(ctx, aliases, node.value.func) in _PARTIAL_BASES
+                and node.value.args):
+            target = dotted(node.value.args[0])
+            if target is not None:
+                partial_bindings[node.targets[0].id] = target.rsplit(".", 1)[-1]
+        if (isinstance(node, ast.Call)
+                and _base_name(ctx, aliases, node.func) in _MAPPED_WRAPPERS):
+            wrapper_calls.append(node)
+            registered.update(_wrapped_callees(ctx, aliases, node))
+    for name in list(registered):  # look through one partial indirection
+        if name in partial_bindings:
+            registered.add(partial_bindings[name])
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ctx.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            for deco in node.decorator_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                if _base_name(ctx, aliases, target) in _MAPPED_WRAPPERS:
+                    registered.add(node.name)
+                elif (isinstance(deco, ast.Call)
+                      and _base_name(ctx, aliases, target) in _PARTIAL_BASES
+                      and deco.args
+                      and _base_name(ctx, aliases, deco.args[0])
+                      in _MAPPED_WRAPPERS):
+                    registered.add(node.name)
+
+    # 2) transitive closure over same-module calls: a helper invoked from a
+    #    mapped function runs under its axis binding (sp_local_loss pattern)
+    mapped_defs = set()
+    frontier = list(registered)
+    while frontier:
+        name = frontier.pop()
+        for fn in defs_by_name.get(name, []):
+            if fn in mapped_defs:
+                continue
+            mapped_defs.add(fn)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                    callee = sub.func.id
+                    if callee in defs_by_name and callee not in registered:
+                        registered.add(callee)
+                        frontier.append(callee)
+
+    out = []
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted(node.func)
+        if fname is None:
+            continue
+        base = fname.rsplit(".", 1)[-1]
+        if base not in _COLLECTIVES:
+            continue
+        if not (fname.startswith("jax.lax.") or fname.startswith("lax.")
+                or ("." not in fname and fname in lax_names)):
+            continue
+        # only axis-named uses: psum(x, "axis") / axis_index("axis")
+        has_axis = (any(kw.arg == "axis_name" for kw in node.keywords)
+                    or len(node.args) >= (1 if base == "axis_index" else 2))
+        if not has_axis:
+            continue
+        enclosing = [anc for anc in ctx.ancestors(node)
+                     if isinstance(anc, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef, ast.Lambda))]
+        # quiet: under a mapped def, or literally inside a wrapper call
+        # expression (shard_map(lambda x: psum(x, "i"), ...))
+        if any(fn in mapped_defs for fn in enclosing):
+            continue
+        if any(anc in wrapper_calls for anc in ctx.ancestors(node)):
+            continue
+        where = (f"`{enclosing[0].name}`"
+                 if enclosing and hasattr(enclosing[0], "name")
+                 else "module scope")
+        out.append(make_finding(
+            ctx, "JX005", node,
+            f"`{fname}` in {where} references a mesh axis, but nothing in "
+            "this module maps it through shard_map/pmap/jit — called "
+            "eagerly this raises `NameError: unbound axis name`; wrap the "
+            "caller in shard_map (or suppress if it is mapped by an "
+            "importer)"))
     return out

@@ -10,6 +10,7 @@ decode entry points.  See docs/SERVING.md for the architecture and the
 token-parity contract with offline ``generate``.
 """
 
+from .dist import DisaggRouter, MeshEngine, PrefillWorker, ShardedPagedPool
 from .engine import InferenceEngine
 from .kvpool import (
     AdmitPlan,
@@ -34,16 +35,20 @@ from .types import (
 __all__ = [
     "AdmitPlan",
     "BlockAllocator",
+    "DisaggRouter",
     "EngineClosedError",
     "EngineConfig",
     "EngineMetrics",
     "EngineOverloadedError",
     "InferenceEngine",
     "KVPoolOOMError",
+    "MeshEngine",
     "PagedKVPool",
+    "PrefillWorker",
     "PrefixCache",
     "PrefixMatch",
     "Request",
+    "ShardedPagedPool",
     "ResponseStream",
     "Scheduler",
     "Slot",

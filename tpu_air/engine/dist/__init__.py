@@ -1,0 +1,46 @@
+"""tpu_air.engine.dist — sharded decode and prefill/decode disaggregation.
+
+Two orthogonal pieces, composable:
+
+* :class:`MeshEngine` — the paged engine's host loop over a leased
+  ``(dp, tp)`` device mesh: pjit'd step bodies, tp-sharded weights,
+  dp-sharded slots/pages (per-replica page pools via
+  :class:`ShardedPagedPool`).
+* :class:`DisaggRouter` + :class:`PrefillWorker` — chunked prefill on
+  separate actor replicas, finished KV pages shipped to the decode
+  engine through the shm object store and admitted via
+  ``submit_prefilled`` (``engine.prefill`` → ``engine.kv_transfer`` →
+  decode under one trace id).
+"""
+
+from .kv_transfer import (
+    extract_kv_pages,
+    insert_kv_pages,
+    payload_nbytes,
+    payload_pages,
+)
+from .mesh_engine import MeshEngine
+from .pool import ShardedPagedPool
+from .prefill_worker import PrefillWorker
+from .router import DisaggRouter
+from .sharded import (
+    make_sharded_page_copy_fn,
+    make_sharded_paged_decode_step_fn,
+    make_sharded_prefill_chunk_fn,
+    paged_cache_shardings,
+)
+
+__all__ = [
+    "MeshEngine",
+    "ShardedPagedPool",
+    "PrefillWorker",
+    "DisaggRouter",
+    "extract_kv_pages",
+    "insert_kv_pages",
+    "payload_nbytes",
+    "payload_pages",
+    "paged_cache_shardings",
+    "make_sharded_paged_decode_step_fn",
+    "make_sharded_prefill_chunk_fn",
+    "make_sharded_page_copy_fn",
+]

@@ -1,0 +1,83 @@
+"""KV-page extraction/insertion — the payload of a prefill→decode handoff.
+
+A PrefillWorker replica runs chunked prefill into its own private paged
+cache, then pulls the prompt's pages out as host numpy arrays keyed by
+layer path; the payload travels through the shm object store
+(core/object_store.py — zero-copy for the numpy leaves via the arena) and
+the decode engine writes the pages into freshly-allocated unshared slots
+of ITS pool.  Page-granular device-to-device DMA is the on-TPU follow-up
+(ROADMAP item 2); this host round-trip is the correctness path and the
+CPU-rig test surface.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def _kv_layers(cache, path=()):
+    """Yield ``('/'.join(path), layer_dict)`` for every attention-layer
+    cache dict (the ones holding cached_key/cached_value pools)."""
+    for k, v in cache.items():
+        if not isinstance(v, dict):
+            continue
+        if "cached_key" in v:
+            yield "/".join(path + (k,)), v
+        else:
+            yield from _kv_layers(v, path + (k,))
+
+
+def extract_kv_pages(cache, page_ids) -> Dict[str, Dict[str, np.ndarray]]:
+    """Pull pages ``page_ids`` (in prompt order) out of a paged cache as
+    host arrays: ``{layer_path: {"k": [n, page_len, h*d], "v": ...}}``."""
+    ids = np.asarray(page_ids, np.int32)
+    out = {}
+    for path, layer in _kv_layers(cache):
+        out[path] = {
+            "k": np.asarray(layer["cached_key"][ids]),
+            "v": np.asarray(layer["cached_value"][ids]),
+        }
+    return out
+
+
+def insert_kv_pages(cache, page_ids, payload: Dict[str, Dict[str, np.ndarray]]):
+    """Write shipped pages into ``page_ids`` of this cache (functional —
+    returns the rebuilt cache; the caller rebinds its donated cache).
+    ``page_ids[i]`` receives the payload's i-th page: id lists on both
+    sides are in prompt order, so source and destination ids need not
+    match — each engine allocates in its own pool."""
+    import jax.numpy as jnp
+
+    ids = jnp.asarray(np.asarray(page_ids, np.int32))
+
+    def walk(d, path=()):
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, dict):
+                if "cached_key" in v:
+                    pages = payload["/".join(path + (k,))]
+                    out[k] = dict(v)
+                    out[k]["cached_key"] = v["cached_key"].at[ids].set(
+                        jnp.asarray(pages["k"]).astype(v["cached_key"].dtype))
+                    out[k]["cached_value"] = v["cached_value"].at[ids].set(
+                        jnp.asarray(pages["v"]).astype(v["cached_value"].dtype))
+                else:
+                    out[k] = walk(v, path + (k,))
+            else:
+                out[k] = v
+        return out
+
+    return walk(cache)
+
+
+def payload_nbytes(payload: Dict[str, Dict[str, np.ndarray]]) -> int:
+    """Total K+V bytes in a handoff payload (the kv_transfer span attr)."""
+    return sum(arr.nbytes for layer in payload.values()
+               for arr in layer.values())
+
+
+def payload_pages(payload: Dict[str, Dict[str, np.ndarray]]) -> int:
+    first = next(iter(payload.values()), None)
+    return int(first["k"].shape[0]) if first else 0

@@ -1,0 +1,235 @@
+"""DisaggRouter — the front door of a prefill/decode-disaggregated engine.
+
+One router owns one decode engine (an :class:`InferenceEngine` or
+:class:`MeshEngine`) plus a family of :class:`PrefillWorker` actor
+replicas.  ``submit`` returns a live :class:`ResponseStream`
+immediately; a per-request dispatcher thread then
+
+1. picks the least-loaded LIVE prefill replica and runs the prompt's
+   chunked prefill there (admission to prefill capacity — the queue
+   forms at the actor mailbox, decode slots stay free for decoding);
+2. under an ``engine.kv_transfer`` span, pulls the finished KV pages
+   out of the shm object store and lands them on the decode engine via
+   ``submit_prefilled`` — the decode engine's OWN capacity gate applies,
+   so pool exhaustion defers the handoff in its admission queue instead
+   of dropping it;
+3. on prefill-replica death (``ActorDiedError``/``RemoteError``/rpc
+   timeout) marks the replica dead and retries the next live one; with
+   NO live replicas left it falls back to a plain ``engine.submit`` on
+   the same stream — the decode engine prefills locally.  Either way the
+   caller's stream completes and in-flight decode streams never notice.
+
+Tracing: the carrier captured at ``submit`` rides to the worker (its
+``engine.prefill`` span) and wraps the transfer + handoff
+(``engine.kv_transfer``); ``scheduler.submit`` inside that span parents
+the decode engine's ``engine.request`` under it — one trace id from
+queue_wait through prefill, kv_transfer and decode, across three
+processes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from tpu_air.core.runtime import ActorDiedError, RemoteError
+
+from ..types import EngineConfig, ResponseStream
+
+
+class DisaggRouter:
+    """Prefill-anywhere, decode-here request router."""
+
+    def __init__(self, checkpoint, engine_config: Optional[EngineConfig] = None,
+                 *, prefill_replicas: int = 2, dtype: Optional[str] = None,
+                 mesh: Optional[tuple] = None, engine=None,
+                 prefill_timeout: float = 120.0, worker_pages: Optional[int] = None,
+                 name: str = "disagg"):
+        if prefill_replicas < 1:
+            raise ValueError("prefill_replicas must be >= 1")
+        self.name = name
+        self.config = engine_config or EngineConfig()
+        self._prefill_timeout = prefill_timeout
+        self._lock = threading.Lock()
+        self._rid = 0
+        self.fallbacks = 0
+        self.reroutes = 0
+        self.handoffs = 0
+        self._rr = 0  # rotates least-loaded ties so idle replicas alternate
+
+        if engine is not None:
+            self.engine = engine
+        else:
+            model, params = checkpoint.get_model(dtype=dtype)
+            if mesh is not None:
+                from .mesh_engine import MeshEngine
+
+                dp, tp = mesh
+                self.engine = MeshEngine(
+                    model, params, self.config, dp=dp, tp=tp,
+                    name=f"{name}-decode")
+            else:
+                from ..engine import InferenceEngine
+
+                self.engine = InferenceEngine(
+                    model, params, self.config, name=f"{name}-decode")
+
+        import tpu_air
+
+        from .prefill_worker import PrefillWorker
+
+        worker_cls = tpu_air.remote(PrefillWorker)
+        self._workers = [
+            worker_cls.remote(
+                checkpoint, page_len=self.config.page_len,
+                slot_len=self.config.slot_len, num_pages=worker_pages,
+                dtype=dtype, name=f"{name}-prefill-{i}",
+            )
+            for i in range(prefill_replicas)
+        ]
+        self._alive = [True] * prefill_replicas
+        self._inflight = [0] * prefill_replicas
+        self.engine.metrics.set_topology(
+            disagg="on", prefill_replicas=prefill_replicas,
+            role="decode",
+        )
+
+    # -- submission ------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None
+               ) -> ResponseStream:
+        """Queue one prompt through the disaggregated path; the stream is
+        live immediately (tokens start at first-token handoff)."""
+        from tpu_air.observability.tracing import current_propagation
+
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+        stream = ResponseStream(rid)
+        carrier = current_propagation()
+        t = threading.Thread(
+            target=self._dispatch,
+            args=(list(prompt), max_new_tokens, stream, carrier),
+            name=f"{self.name}-dispatch-{rid}", daemon=True,
+        )
+        t.start()
+        return stream
+
+    def generate(self, prompts, max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = 120.0) -> List[List[int]]:
+        streams = [self.submit(p, max_new_tokens) for p in prompts]
+        return [s.result(timeout) for s in streams]
+
+    # -- replica choice --------------------------------------------------------
+    def _pick_replica(self) -> Optional[int]:
+        with self._lock:
+            live = [i for i, ok in enumerate(self._alive) if ok]
+            if not live:
+                return None
+            # least-loaded wins; ties rotate round-robin so a stream of
+            # sequential (never-overlapping) requests still spreads
+            n = len(self._workers)
+            i = min(live,
+                    key=lambda j: (self._inflight[j], (j - self._rr) % n))
+            self._rr = i + 1
+            self._inflight[i] += 1
+            return i
+
+    def _mark_dead(self, i: int) -> None:
+        with self._lock:
+            if self._alive[i]:
+                self._alive[i] = False
+                self.reroutes += 1
+
+    def live_prefill_replicas(self) -> int:
+        with self._lock:
+            return sum(self._alive)
+
+    # -- the per-request dispatcher -------------------------------------------
+    def _dispatch(self, prompt, max_new, stream, carrier) -> None:
+        try:
+            self._dispatch_inner(prompt, max_new, stream, carrier)
+        except BaseException as e:  # never strand the caller's stream
+            stream._finish(e)
+
+    def _dispatch_inner(self, prompt, max_new, stream, carrier) -> None:
+        import tpu_air
+        from tpu_air.observability.tracing import task_span
+
+        from .kv_transfer import payload_nbytes, payload_pages
+
+        result = None
+        while result is None:
+            i = self._pick_replica()
+            if i is None:
+                break  # every prefill replica is dead
+            try:
+                ref = self._workers[i].prefill.remote(prompt, carrier)
+                result = tpu_air.get(ref, timeout=self._prefill_timeout)
+            except (ActorDiedError, RemoteError, TimeoutError):
+                self._mark_dead(i)
+            finally:
+                with self._lock:
+                    self._inflight[i] -= 1
+        if result is None:
+            # no live prefill capacity: the decode engine prefills locally
+            # on the SAME stream — degraded, never dropped
+            with self._lock:
+                self.fallbacks += 1
+            self.engine.submit(prompt, max_new, stream=stream)
+            return
+        with task_span("engine.kv_transfer", carrier) as sp:
+            payload = tpu_air.get(result["kv"])
+            if sp is not None and hasattr(sp, "attrs"):
+                sp.attrs.update({
+                    "kv_bytes": payload_nbytes(payload),
+                    "pages": payload_pages(payload),
+                    "prompt_len": result["prompt_len"],
+                })
+            # scheduler.submit captures THIS span as the request's trace
+            # parent: decode joins the same trace as prefill + transfer
+            self.engine.submit_prefilled(
+                prompt, result["first_token"], payload, max_new,
+                stream=stream)
+        with self._lock:
+            self.handoffs += 1
+
+    # -- observability / lifecycle --------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "name": self.name,
+                "prefill_replicas": len(self._workers),
+                "live_prefill_replicas": sum(self._alive),
+                "handoffs": self.handoffs,
+                "reroutes": self.reroutes,
+                "fallbacks": self.fallbacks,
+            }
+        worker_stats = []
+        for i, w in enumerate(self._workers):
+            if not self._alive[i]:
+                worker_stats.append({"name": f"{self.name}-prefill-{i}",
+                                     "dead": True})
+                continue
+            try:
+                import tpu_air
+
+                worker_stats.append(
+                    tpu_air.get(w.stats.remote(), timeout=10.0))
+            except (ActorDiedError, RemoteError, TimeoutError):
+                self._mark_dead(i)
+                worker_stats.append({"name": f"{self.name}-prefill-{i}",
+                                     "dead": True})
+        out["workers"] = worker_stats
+        out["engine"] = self.engine.metrics.snapshot()
+        return out
+
+    def close(self) -> None:
+        import tpu_air
+
+        self.engine.close()
+        for i, w in enumerate(self._workers):
+            if self._alive[i]:
+                try:
+                    tpu_air.kill(w)
+                except Exception:  # best-effort teardown races actor death
+                    pass

@@ -1,0 +1,98 @@
+"""pjit wrappers for the paged engine's compiled phases over a
+``(data, model)`` mesh.
+
+The sharded engine runs the SAME step bodies as the single-chip engine
+(models/lm/generate.py ``make_paged_decode_body`` /
+``make_prefill_chunk_body`` / ``page_copy_body``) — only the jit options
+differ: explicit ``in_shardings``/``out_shardings`` place the KV page
+pools, per-slot indices and block tables over the ``data`` axis and the
+q/k/v/gate/up/o/down kernels over ``model`` (parallel/sharding.py
+``lm_param_spec``), and XLA's SPMD partitioner inserts the tensor-parallel
+all-reduces the unchanged model code needs.  ``gather_pages`` runs
+untouched inside each dp shard: the ShardedPagedPool hands out page ids
+laid out so every slot's pages live in that slot's own data shard
+(engine/dist/pool.py), making the gather shard-local.
+
+Layout over a ``(dp, tp)`` mesh:
+
+* ``cached_key`` / ``cached_value`` ``[P, page_len, h*d]`` →
+  ``P("data", None, None)`` — pages split across dp replicas;
+* ``cache_index`` ``[S]`` → ``P("data")``; ``block_table``
+  ``[S, pages_per_slot]`` → ``P("data", None)`` — slots follow pages;
+* decode ``tok``/``pos`` ``[S]`` → ``P("data")``; prefill chunk args
+  (b=1 work) and CoW page ids → replicated.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_air.models.lm.generate import (
+    make_paged_decode_body,
+    make_prefill_chunk_body,
+    page_copy_body,
+)
+
+
+def paged_cache_shardings(cache, mesh):
+    """NamedSharding tree matching an ``init_paged_cache`` result: page
+    pools and slot-indexed leaves over ``data``, everything else
+    replicated."""
+
+    def walk(d):
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            elif k in ("cached_key", "cached_value"):
+                out[k] = NamedSharding(mesh, P("data", None, None))
+            elif k == "cache_index":
+                out[k] = NamedSharding(mesh, P("data"))
+            elif k == "block_table":
+                out[k] = NamedSharding(mesh, P("data", None))
+            else:
+                out[k] = NamedSharding(mesh, P())
+        return out
+
+    return walk(cache)
+
+
+def make_sharded_paged_decode_step_fn(model, slot_len: int, mesh,
+                                      param_shardings, cache_shardings):
+    """The MeshEngine decode step: same body and donate contract as
+    ``make_lm_paged_decode_step_fn``, with batch args over ``data``."""
+    batch = NamedSharding(mesh, P("data"))
+    table = NamedSharding(mesh, P("data", None))
+    return jax.jit(
+        make_paged_decode_body(model, slot_len),
+        donate_argnums=(1,),
+        in_shardings=(param_shardings, cache_shardings, batch, batch, table),
+        out_shardings=(cache_shardings, batch),
+    )
+
+
+def make_sharded_prefill_chunk_fn(model, page_len: int, slot_len: int, mesh,
+                                  param_shardings, cache_shardings):
+    """The MeshEngine chunked-prefill unit: chunk args replicate (one b=1
+    chunk is broadcast work; only its page writes land in a data shard)."""
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        make_prefill_chunk_body(model, page_len, slot_len),
+        donate_argnums=(1,),
+        in_shardings=(param_shardings, cache_shardings, repl, repl, repl,
+                      repl),
+        out_shardings=(cache_shardings, repl),
+    )
+
+
+def make_sharded_page_copy_fn(mesh, cache_shardings):
+    """Copy-on-write under pjit.  The ShardedPagedPool always resolves CoW
+    within one replica's page range, so the copy never crosses shards."""
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        page_copy_body,
+        donate_argnums=(0,),
+        in_shardings=(cache_shardings, repl, repl),
+        out_shardings=cache_shardings,
+    )

@@ -102,26 +102,12 @@ class InferenceEngine:
         self.paged = cfg.kv_mode == "paged"
 
         # device side: the persistent donated KV pool + compiled phases
+        # (subclasses override the builders — MeshEngine swaps in a sharded
+        # pool/cache and pjit-wrapped step fns, same host loop)
         if self.paged:
-            self.pool = PagedKVPool(
-                cfg.pool_pages(), cfg.page_len, cfg.num_slots,
-                cfg.pages_per_slot(), prefix_cache=cfg.prefix_cache,
-            )
-            self.cache = init_paged_cache(
-                model, cfg.num_slots, cfg.pool_pages(), cfg.page_len,
-                cfg.pages_per_slot(),
-            )
-            self._decode_step = make_lm_paged_decode_step_fn(
-                model, cfg.slot_len)
-            self._chunk_fn = make_lm_prefill_chunk_fn(
-                model, cfg.page_len, cfg.slot_len)
-            self._copy_fn = make_page_copy_fn()
+            self._build_paged_state()
         else:
-            self.pool = None
-            self.cache = init_slot_cache(model, cfg.num_slots, cfg.slot_len)
-            self._decode_step = make_lm_decode_step_fn(model, cfg.slot_len)
-            self._insert = make_insert_fn()
-            self._prefill_fns: Dict[int, Any] = {}  # bucket -> compiled
+            self._build_slab_state()
 
         # host side: authoritative per-slot state the step args come from
         self._cur_tok = np.zeros((cfg.num_slots,), np.int32)
@@ -141,10 +127,34 @@ class InferenceEngine:
         if auto_start:
             self.start()
 
+    # -- device-state builders (overridden by engine/dist MeshEngine) --------
+    def _build_paged_state(self) -> None:
+        cfg = self.config
+        self.pool = PagedKVPool(
+            cfg.pool_pages(), cfg.page_len, cfg.num_slots,
+            cfg.pages_per_slot(), prefix_cache=cfg.prefix_cache,
+        )
+        self.cache = init_paged_cache(
+            self.model, cfg.num_slots, cfg.pool_pages(), cfg.page_len,
+            cfg.pages_per_slot(),
+        )
+        self._decode_step = make_lm_paged_decode_step_fn(
+            self.model, cfg.slot_len)
+        self._chunk_fn = make_lm_prefill_chunk_fn(
+            self.model, cfg.page_len, cfg.slot_len)
+        self._copy_fn = make_page_copy_fn()
+
+    def _build_slab_state(self) -> None:
+        cfg = self.config
+        self.pool = None
+        self.cache = init_slot_cache(self.model, cfg.num_slots, cfg.slot_len)
+        self._decode_step = make_lm_decode_step_fn(self.model, cfg.slot_len)
+        self._insert = make_insert_fn()
+        self._prefill_fns: Dict[int, Any] = {}  # bucket -> compiled
+
     # -- submission (any thread) ---------------------------------------------
-    def submit(self, prompt: Sequence[int],
-               max_new_tokens: Optional[int] = None) -> ResponseStream:
-        """Queue one prompt; returns its token stream immediately."""
+    def _make_request(self, prompt, max_new_tokens, stream) -> Request:
+        """Shared validation + Request construction for both submit paths."""
         if self._closed:
             raise EngineClosedError("engine is shut down")
         prompt = [int(t) for t in prompt]
@@ -162,16 +172,49 @@ class InferenceEngine:
         with self._id_lock:
             rid = self._next_request_id
             self._next_request_id += 1
-        stream = ResponseStream(rid)
-        req = Request(request_id=rid, prompt=prompt, max_new_tokens=budget,
-                      stream=stream)
+        return Request(request_id=rid, prompt=prompt, max_new_tokens=budget,
+                       stream=stream if stream is not None
+                       else ResponseStream(rid))
+
+    def _enqueue(self, req: Request) -> ResponseStream:
         try:
             self.scheduler.submit(req)
         except EngineOverloadedError:  # backpressure: count the 503, surface it
             self.metrics.record_reject()
             raise
         self.metrics.record_submit()
-        return stream
+        return req.stream
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None, *,
+               stream: Optional[ResponseStream] = None) -> ResponseStream:
+        """Queue one prompt; returns its token stream immediately.
+
+        ``stream`` lets a front-end that already handed a stream to its
+        caller (the disagg router's prefill-fallback path) have the engine
+        emit onto it instead of minting a fresh one."""
+        return self._enqueue(self._make_request(prompt, max_new_tokens,
+                                                stream))
+
+    def submit_prefilled(self, prompt: Sequence[int], first_token: int,
+                         kv_pages: Dict[str, Any],
+                         max_new_tokens: Optional[int] = None, *,
+                         stream: Optional[ResponseStream] = None
+                         ) -> ResponseStream:
+        """Queue a request whose prefill ALREADY RAN elsewhere (a
+        PrefillWorker replica — engine/dist/): ``kv_pages`` is the
+        extract_kv_pages payload covering the whole prompt and
+        ``first_token`` the prefill's greedy first token.  Admission
+        allocates unshared pages, inserts the shipped K/V, emits
+        ``first_token`` and goes straight to decode — same capacity gate
+        and deferral as a normal submit, so pool exhaustion queues the
+        handoff instead of dropping it."""
+        if not self.paged:
+            raise ValueError(
+                "submit_prefilled requires a paged engine (kv_mode='paged')")
+        req = self._make_request(prompt, max_new_tokens, stream)
+        req.prefilled = {"first_token": int(first_token), "pages": kv_pages}
+        return self._enqueue(req)
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: Optional[int] = None,
@@ -192,7 +235,7 @@ class InferenceEngine:
         ``while engine.step(): ...`` to drain)."""
         with self._step_lock:
             worked = False
-            self._round_reserved = 0
+            self._begin_admission_round()
             can_admit = self._can_admit if self.paged else None
             for req in self.scheduler.pop_admissible(
                 self.slots.free_count(), can_admit
@@ -223,6 +266,12 @@ class InferenceEngine:
         return self.scheduler.depth() == 0 and self.slots.occupancy() == 0
 
     # -- paged admission -----------------------------------------------------
+    def _begin_admission_round(self) -> None:
+        """Reset per-round reservation state before ``pop_admissible``
+        probes the queue (the MeshEngine override tracks reservations PER
+        dp REPLICA, simulating which replica each admit will land in)."""
+        self._round_reserved = 0
+
     def _can_admit(self, req: Request) -> bool:
         """Page-capacity gate for the scheduler: answers whether the pool
         can cover the request's WORST CASE (no prefix sharing — a prior
@@ -241,11 +290,57 @@ class InferenceEngine:
     def _admit_paged(self, req: Request) -> None:
         """Reserve pages + block-table row; actual compute happens in the
         chunked prefill quantum (no first token yet — TTFT lands when the
-        final chunk runs)."""
+        final chunk runs).  A request carrying shipped KV pages skips the
+        chunk phase entirely (prefill already ran on another replica)."""
         slot = self.slots.acquire()
         slot.request = req
+        if req.prefilled is not None:
+            self._admit_prefilled(slot, req)
+            return
         slot.prefilling = True
         slot.plan = self.pool.admit(slot.index, req.prompt, req.max_new_tokens)
+
+    def _admit_prefilled(self, slot: Slot, req: Request) -> None:
+        """Disaggregated handoff landing (engine/dist/): allocate UNSHARED
+        pages (the shipped K/V is written into them — a write must never
+        touch a prefix-shared page), insert the pages, emit the worker's
+        first token, and hand the slot straight to decode.  ``register``
+        then publishes the now-populated prompt pages to this engine's
+        prefix cache, so later LOCAL submits share them normally."""
+        n = len(req.prompt)
+        slot.plan = self.pool.admit(
+            slot.index, req.prompt, req.max_new_tokens, share=False)
+        slot.plan.chunks_done = len(slot.plan.chunk_starts)  # nothing to run
+        page_ids = self.pool.prompt_page_ids(slot.index, n)
+        self.cache = self._insert_shipped_pages(
+            self.cache, page_ids, req.prefilled["pages"])
+        first = int(req.prefilled["first_token"])
+        req.first_token_at = time.monotonic()
+        if req.t_submit_ns:
+            # t_first == t_admit: the > guard in _emit_request_spans keeps
+            # the (remote) prefill from double-reporting as a local span
+            req.t_first_ns = req.t_admit_ns
+        self.metrics.record_ttft(req.first_token_at - req.submitted_at)
+        req.stream._emit(first)
+        self.metrics.record_tokens(1)
+        self.pool.register(slot.index, req.prompt)
+        slot.prefilling = False
+        slot.pos = n
+        slot.budget_left = req.max_new_tokens - 1
+        self._cur_tok[slot.index] = first
+        self._pos[slot.index] = n
+        if slot.budget_left == 0 or (
+            self.eos_token_id is not None and first == self.eos_token_id
+        ):
+            self._retire(slot)
+
+    def _insert_shipped_pages(self, cache, page_ids, payload):
+        """Write a disaggregated handoff's KV pages into ``page_ids`` of the
+        donated cache (MeshEngine re-places the rebuilt leaves onto its
+        shardings afterwards)."""
+        from .dist.kv_transfer import insert_kv_pages  # lazy: avoids cycle
+
+        return insert_kv_pages(cache, page_ids, payload)
 
     def _prefill_quantum(self) -> bool:
         """Run up to ``prefill_chunks_per_step`` prefill chunk calls,
@@ -347,6 +442,13 @@ class InferenceEngine:
             self._retire(slot)
 
     # -- decode --------------------------------------------------------------
+    def _null_entry(self, slot_index: int) -> int:
+        """The page id a non-decoding slot's table row is masked with.  The
+        single-chip pool has one null page (id 0); the MeshEngine override
+        returns the slot's OWN replica's null page so the ride-along
+        scatter never crosses a data shard."""
+        return 0
+
     def _decode_all(self) -> None:
         t0 = time.monotonic()
         if self.paged:
@@ -356,7 +458,7 @@ class InferenceEngine:
             table = self.pool.block_table.copy()
             for s in self.slots.slots:
                 if not s.active or s.prefilling:
-                    table[s.index] = 0
+                    table[s.index] = self._null_entry(s.index)
             self.cache, nxt = self._decode_step(
                 self.params, self.cache,
                 jnp.asarray(self._cur_tok), jnp.asarray(self._pos),
@@ -424,7 +526,9 @@ class InferenceEngine:
                 trace_id=root.trace_id, parent_id=root.span_id,
                 start_ns=req.t_submit_ns, end_ns=req.t_admit_ns,
             )
-        if req.t_admit_ns and req.t_first_ns:
+        if req.t_admit_ns and req.t_first_ns > req.t_admit_ns:
+            # strictly-after: a disaggregated request lands with t_first ==
+            # t_admit (its prefill span was recorded on the worker replica)
             attrs = {"slot": slot.index, "prompt_len": len(req.prompt)}
             if self.paged and slot.plan is not None:
                 attrs["chunks"] = len(slot.plan.chunk_starts)

@@ -108,16 +108,22 @@ class PagedKVPool:
         return cap
 
     # -- admission -----------------------------------------------------------
-    def admit(self, slot: int, prompt, budget: int) -> AdmitPlan:
+    def admit(self, slot: int, prompt, budget: int,
+              share: bool = True) -> AdmitPlan:
         """Reserve every page the request can touch, share what the prefix
-        cache covers, and return the chunk work list."""
+        cache covers, and return the chunk work list.
+
+        ``share=False`` skips prefix matching and allocates every page
+        fresh — the disaggregated handoff path (engine/dist/): shipped KV
+        pages are about to be WRITTEN into this slot's pages, and a write
+        must never land on a page other holders read."""
         C = self.page_len
         n = len(prompt)
         total = self._total_pages(n, budget)
         assert total <= self.pages_per_slot, (total, self.pages_per_slot)
 
-        match = (self.prefix.match(prompt) if self.prefix is not None
-                 else None)
+        match = (self.prefix.match(prompt)
+                 if share and self.prefix is not None else None)
         shared = list(match.pages) if match else []
         tail_page = match.tail_page if match else None
         prefix_tokens = match.matched_tokens if match else 0
@@ -178,6 +184,13 @@ class PagedKVPool:
         tmp = row.copy()
         tmp[start // self.page_len] = NULL_PAGE
         return tmp
+
+    def prompt_page_ids(self, slot: int, n_tokens: int) -> List[int]:
+        """The page ids holding the first ``n_tokens`` positions of the
+        slot's context — the pages a disaggregated KV handoff ships/fills
+        (engine/dist/kv_transfer.py)."""
+        n_pages = -(-n_tokens // self.page_len)
+        return [int(p) for p in self.block_table[slot][:n_pages]]
 
     def register(self, slot: int, prompt) -> int:
         """Publish the slot's full prompt chunks to the prefix cache (after

@@ -59,7 +59,16 @@ class EngineMetrics:
         self.kvpool: Dict[str, Any] = {}
         self.reordered_admits = 0
         self.prefill_chunks = 0
+        # mesh/lease/role metadata (empty for single-chip engines)
+        self.topology: Dict[str, Any] = {}
         register(self)
+
+    def set_topology(self, **kw: Any) -> None:
+        """Attach placement metadata (lease id, mesh shape, role, replica
+        counts).  String values surface as an info-line's labels; numeric
+        values as gauges.  Set once at engine construction."""
+        with self._lock:
+            self.topology.update(kw)
 
     # -- engine-side recording ----------------------------------------------
     def observe_gauges(self, queue_depth: int, slot_occupancy: int,
@@ -151,6 +160,8 @@ class EngineMetrics:
                 out["kvpool"] = dict(self.kvpool)
                 out["reordered_admits"] = self.reordered_admits
                 out["prefill_chunks"] = self.prefill_chunks
+            if self.topology:
+                out["topology"] = dict(self.topology)
         out["tokens_per_s"] = self.tokens_per_s()
         return out
 
@@ -217,4 +228,23 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
         for key in ("reordered_admits", "prefill_chunks"):
             if key in snap:
                 lines.append(f"tpu_air_engine_{key}{tag} {snap[key]}")
+        # topology: strings fold into one info line's labels, numbers
+        # (replica counts, device counts) become gauges
+        topo = snap.get("topology") or {}
+        if topo:
+            from tpu_air.utils.metrics import sanitize_metric_name
+
+            info = [f'engine="{label}"']
+            for key, val in sorted(topo.items()):
+                # keys become metric-name / label-name fragments: sanitize.
+                # values are label VALUES — any charset, quote-escape only
+                skey = sanitize_metric_name(str(key))
+                if isinstance(val, bool) or not isinstance(val, (int, float)):
+                    sval = str(val).replace("\\", "\\\\").replace('"', '\\"')
+                    info.append(f'{skey}="{sval}"')
+                else:
+                    lines.append(
+                        f"tpu_air_engine_topology_{skey}{tag} {val:g}")
+            lines.append(
+                "tpu_air_engine_topology_info{" + ",".join(info) + "} 1")
     return lines

@@ -58,6 +58,12 @@ class SlotManager:
     def free_count(self) -> int:
         return len(self._free)
 
+    def free_indices(self) -> List[int]:
+        """Free rows in ACQUIRE order (lowest first) — what an admission
+        predicate that must know which row each admit will land in (the
+        MeshEngine's per-replica capacity gate) simulates against."""
+        return sorted(self._free)
+
     def active_slots(self) -> List[Slot]:
         return [s for s in self.slots if s.active]
 

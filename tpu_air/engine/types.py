@@ -185,3 +185,9 @@ class Request:
     t_submit_ns: int = 0
     t_admit_ns: int = 0
     t_first_ns: int = 0
+    # disaggregated serving (engine/dist/): a request whose prefill ran on
+    # a PrefillWorker replica arrives with its first token and the prompt's
+    # KV pages ({"first_token": int, "pages": {layer_path: {"k", "v"}}});
+    # admission inserts the pages and goes straight to decode.  None for
+    # the normal (engine-prefills) path.
+    prefilled: Optional[dict] = None

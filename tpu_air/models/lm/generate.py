@@ -322,19 +322,16 @@ def init_paged_cache(model: CausalLM, num_slots: int, num_pages: int,
     return rebuild(base)
 
 
-def make_lm_paged_decode_step_fn(model: CausalLM, slot_len: int):
-    """The persistent paged engine step: jitted ``fn(params, cache, tok,
-    pos, block_table) -> (cache', next_tok)``, cache donated.  Identical
-    contract to :func:`make_lm_decode_step_fn` plus the block table
-    ``[S, pages_per_slot]`` int32 (the host pool's authoritative table —
-    rows of non-decoding slots pointed at the null page so their ride-along
-    scatter can't touch a live or prefix-shared page)."""
+def make_paged_decode_body(model: CausalLM, slot_len: int):
+    """The UNJITTED paged decode step body: ``fn(params, cache, tok, pos,
+    block_table) -> (cache', next_tok)``.  Both the single-chip factory
+    below and the sharded factory (engine/dist/sharded.py, which adds
+    pjit in/out shardings over a ``(data, model)`` mesh) wrap this same
+    body — parity between the two engines is parity of jit options, not
+    of two step implementations."""
     cfg = model.config
     dcfg = {**cfg.to_dict(), "max_seq_len": slot_len}
 
-    from functools import partial
-
-    @partial(jax.jit, donate_argnums=(1,))
     def step(params, cache, tok, pos, block_table):
         dmodel = CausalLM(LMConfig.from_dict(dcfg))
         pos = pos.astype(jnp.int32)
@@ -355,33 +352,26 @@ def make_lm_paged_decode_step_fn(model: CausalLM, slot_len: int):
     return step
 
 
-def make_lm_prefill_chunk_fn(model: CausalLM, page_len: int, slot_len: int):
-    """Build THE chunked-prefill unit: a jitted ``fn(params, cache, ids,
-    p0, last_local, table_row) -> (cache', tok)``, cache donated.
+def make_lm_paged_decode_step_fn(model: CausalLM, slot_len: int):
+    """The persistent paged engine step: jitted ``fn(params, cache, tok,
+    pos, block_table) -> (cache', next_tok)``, cache donated.  Identical
+    contract to :func:`make_lm_decode_step_fn` plus the block table
+    ``[S, pages_per_slot]`` int32 (the host pool's authoritative table —
+    rows of non-decoding slots pointed at the null page so their ride-along
+    scatter can't touch a live or prefix-shared page)."""
+    return jax.jit(make_paged_decode_body(model, slot_len),
+                   donate_argnums=(1,))
 
-    One call processes ONE page-sized chunk of ONE slot's prompt:
 
-    * ``ids`` ``[1, page_len]`` — the chunk's tokens, right-padded on the
-      final (partial) chunk.  Pad positions write don't-care K/V into the
-      page tail; the per-slot validity mask hides them until decode
-      appends overwrite them — the slab engine's stale-bytes discipline.
-    * ``p0`` — the chunk's first global position (page-aligned).
-    * ``last_local`` — index of the prompt's last real token WITHIN this
-      chunk, valid only on the final chunk; the returned greedy first
-      token is read there (intermediate chunks' tok is discarded).
-    * ``table_row`` ``[pages_per_slot]`` — the slot's block-table row (the
-      pool may substitute the null page for a fully-prefix-covered
-      prompt's re-run tail chunk: PagedKVPool.chunk_row).
-
-    Fixed shapes -> ONE compiled program covers every prompt length; the
-    engine interleaves these calls between decode steps so long prompts
-    stream in without stalling in-flight decodes."""
+def make_prefill_chunk_body(model: CausalLM, page_len: int, slot_len: int):
+    """The UNJITTED chunked-prefill body: ``fn(params, cache, ids, p0,
+    last_local, table_row) -> (cache', tok)`` — shared by the single-chip
+    jit wrapper below and the sharded pjit wrapper (engine/dist/sharded.py,
+    where ids/p0/last_local/table_row replicate: a chunk is b=1 work, only
+    its page writes land in a data shard)."""
     cfg = model.config
     dcfg = {**cfg.to_dict(), "max_seq_len": slot_len}
 
-    from functools import partial
-
-    @partial(jax.jit, donate_argnums=(1,))
     def prefill_chunk(params, cache, ids, p0, last_local, table_row):
         dmodel = CausalLM(LMConfig.from_dict(dcfg))
         p0 = p0.astype(jnp.int32)
@@ -408,36 +398,62 @@ def make_lm_prefill_chunk_fn(model: CausalLM, page_len: int, slot_len: int):
     return prefill_chunk
 
 
+def make_lm_prefill_chunk_fn(model: CausalLM, page_len: int, slot_len: int):
+    """Build THE chunked-prefill unit: a jitted ``fn(params, cache, ids,
+    p0, last_local, table_row) -> (cache', tok)``, cache donated.
+
+    One call processes ONE page-sized chunk of ONE slot's prompt:
+
+    * ``ids`` ``[1, page_len]`` — the chunk's tokens, right-padded on the
+      final (partial) chunk.  Pad positions write don't-care K/V into the
+      page tail; the per-slot validity mask hides them until decode
+      appends overwrite them — the slab engine's stale-bytes discipline.
+    * ``p0`` — the chunk's first global position (page-aligned).
+    * ``last_local`` — index of the prompt's last real token WITHIN this
+      chunk, valid only on the final chunk; the returned greedy first
+      token is read there (intermediate chunks' tok is discarded).
+    * ``table_row`` ``[pages_per_slot]`` — the slot's block-table row (the
+      pool may substitute the null page for a fully-prefix-covered
+      prompt's re-run tail chunk: PagedKVPool.chunk_row).
+
+    Fixed shapes -> ONE compiled program covers every prompt length; the
+    engine interleaves these calls between decode steps so long prompts
+    stream in without stalling in-flight decodes."""
+    return jax.jit(make_prefill_chunk_body(model, page_len, slot_len),
+                   donate_argnums=(1,))
+
+
+def page_copy_body(cache, dst, src):
+    """The UNJITTED copy-on-write body: copy page ``src`` onto page ``dst``
+    in every layer's K and V pools; index and table leaves pass through.
+    Wrapped by :func:`make_page_copy_fn` (single chip) and the sharded
+    factory (engine/dist/sharded.py)."""
+    dst = dst.astype(jnp.int32) if hasattr(dst, "astype") else dst
+    src = src.astype(jnp.int32) if hasattr(src, "astype") else src
+
+    def walk(d):
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            elif k in ("cached_key", "cached_value"):
+                page = jax.lax.dynamic_slice(
+                    v, (src, 0, 0), (1,) + v.shape[1:])
+                out[k] = jax.lax.dynamic_update_slice(
+                    v, page, (dst, 0, 0))
+            else:
+                out[k] = v
+        return out
+
+    return walk(cache)
+
+
 def make_page_copy_fn():
     """Build the copy-on-write primitive: a jitted ``fn(cache, dst, src) ->
     cache'`` (cache donated) copying page ``src`` onto page ``dst`` in every
     layer's K and V pools.  Run once when a slot's first decode append would
-    land in a prefix-shared tail page (PagedKVPool.resolve_cow); index and
-    table leaves pass through untouched."""
-    from functools import partial
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def copy_page(cache, dst, src):
-        dst = dst.astype(jnp.int32) if hasattr(dst, "astype") else dst
-        src = src.astype(jnp.int32) if hasattr(src, "astype") else src
-
-        def walk(d):
-            out = {}
-            for k, v in d.items():
-                if isinstance(v, dict):
-                    out[k] = walk(v)
-                elif k in ("cached_key", "cached_value"):
-                    page = jax.lax.dynamic_slice(
-                        v, (src, 0, 0), (1,) + v.shape[1:])
-                    out[k] = jax.lax.dynamic_update_slice(
-                        v, page, (dst, 0, 0))
-                else:
-                    out[k] = v
-            return out
-
-        return walk(cache)
-
-    return copy_page
+    land in a prefix-shared tail page (PagedKVPool.resolve_cow)."""
+    return jax.jit(page_copy_body, donate_argnums=(0,))
 
 
 _GEN_CACHE: Dict[Tuple, Any] = {}

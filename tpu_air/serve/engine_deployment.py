@@ -43,13 +43,22 @@ class _EngineServer:
         dtype: Optional[str] = None,
         engine_name: str = "engine",
         join_timeout: float = 300.0,
+        mesh: Optional[tuple] = None,
+        disagg: Optional[Dict[str, Any]] = None,
     ):
         self._checkpoint = checkpoint
         self._engine_config = engine_config
         self._dtype = dtype
         self._engine_name = engine_name
         self._join_timeout = join_timeout
+        # distributed serving (tpu_air.engine.dist): ``mesh=(dp, tp)``
+        # builds a MeshEngine over a leased device mesh; ``disagg=`` (a
+        # kwargs dict for DisaggRouter, e.g. {"prefill_replicas": 2})
+        # routes prefill through separate worker actors.  Both compose.
+        self._mesh = tuple(mesh) if mesh is not None else None
+        self._disagg = dict(disagg) if disagg is not None else None
         self._engine = None
+        self._router = None
         self._streams: Dict[int, Any] = {}
 
     def _ensure_engine(self):
@@ -76,16 +85,43 @@ class _EngineServer:
             # gets the window engine (batch-synchronized T5 decode), any
             # EngineConfig (or None) the causal-LM slot/page engine
             if isinstance(self._engine_config, T5EngineConfig):
+                if self._mesh or self._disagg:
+                    raise ValueError(
+                        "mesh/disagg serving supports the causal-LM paged "
+                        "engine only")
                 self._engine = T5Engine(
                     model, params, self._engine_config,
                     name=self._engine_name,
+                )
+            elif self._mesh is not None:
+                from tpu_air.engine import MeshEngine
+
+                dp, tp = self._mesh
+                self._engine = MeshEngine(
+                    model, params, self._engine_config or EngineConfig(),
+                    dp=dp, tp=tp, name=self._engine_name,
                 )
             else:
                 self._engine = InferenceEngine(
                     model, params, self._engine_config or EngineConfig(),
                     name=self._engine_name,
                 )
+            if self._disagg is not None:
+                from tpu_air.engine import DisaggRouter
+
+                self._router = DisaggRouter(
+                    self._checkpoint,
+                    self._engine_config or EngineConfig(),
+                    engine=self._engine, dtype=self._dtype,
+                    name=self._engine_name, **self._disagg,
+                )
         return self._engine
+
+    def _front(self):
+        """The submit surface: the disagg router when configured (prefill
+        on worker actors), else the engine itself."""
+        self._ensure_engine()
+        return self._router if self._router is not None else self._engine
 
     # -- blocking HTTP path ---------------------------------------------------
     def __call__(self, payload) -> Dict[str, Any]:
@@ -101,9 +137,9 @@ class _EngineServer:
         if not prompts:
             raise ValueError('payload needs "prompt" or a non-empty "prompts"')
         max_new = payload.get("max_new_tokens")
-        engine = self._ensure_engine()
+        front = self._front()
         # submit ALL before joining ANY — concurrent prompts share pool steps
-        streams = [engine.submit(p, max_new) for p in prompts]
+        streams = [front.submit(p, max_new) for p in prompts]
         return {
             "results": [
                 {"request_id": s.request_id,
@@ -114,7 +150,7 @@ class _EngineServer:
 
     # -- streaming path (actor RPC) -------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None) -> int:
-        stream = self._ensure_engine().submit(prompt, max_new_tokens)
+        stream = self._front().submit(prompt, max_new_tokens)
         self._streams[stream.request_id] = stream
         return stream.request_id
 
@@ -133,7 +169,17 @@ class _EngineServer:
         # load + compile) — no engine yet means nothing to report
         if self._engine is None:
             return {}
-        return self._engine.metrics.snapshot()
+        snap = self._engine.metrics.snapshot()
+        if self._router is not None:
+            rst = self._router.stats()
+            snap.setdefault("topology", {}).update(
+                disagg="on",
+                prefill_replicas=rst["prefill_replicas"],
+                live_prefill_replicas=rst["live_prefill_replicas"],
+            )
+            snap["disagg"] = {k: rst[k] for k in
+                              ("handoffs", "reroutes", "fallbacks")}
+        return snap
 
 
 EngineDeployment = Deployment(
