@@ -1,0 +1,78 @@
+"""Tier-1 smoke for the bench regression gate.
+
+The first test IS the CI gate: it runs tools/bench_gate.py against the
+committed artifacts + committed baseline, so a PR that regresses a
+headline bench number (or forgets to commit an artifact the baseline
+names) fails tier-1 loudly.  The rest exercise the gate's own logic on
+synthetic artifacts in a tmp root.
+"""
+
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0] + "/tools")
+import bench_gate  # noqa: E402
+
+
+def test_committed_artifacts_pass_gate(capsys):
+    rc = bench_gate.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, f"committed bench artifacts regressed:\n{out}"
+    assert "all headline fields within threshold" in out
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+@pytest.fixture
+def synthetic(tmp_path):
+    baseline = _write(tmp_path, "base.json", {
+        "threshold": 0.2,
+        "benches": {"BENCH_x.json": {
+            "tokens_per_s": {"value": 100.0, "direction": "higher"},
+            "p99_ratio": {"value": 1.0, "direction": "lower"},
+            "shed": {"value": 0, "direction": "lower"},
+        }},
+    })
+
+    def run(artifact):
+        _write(tmp_path, "BENCH_x.json", artifact)
+        return bench_gate.main(["--baseline", baseline,
+                                "--root", str(tmp_path)])
+
+    return run
+
+
+def test_gate_fails_on_regression_past_threshold(synthetic, capsys):
+    # 30% throughput drop > 20% threshold
+    assert synthetic({"tokens_per_s": 70.0, "p99_ratio": 1.0,
+                      "shed": 0}) == 1
+    assert "regressed" in capsys.readouterr().out
+
+
+def test_gate_passes_within_threshold_and_on_improvement(synthetic):
+    assert synthetic({"tokens_per_s": 85.0, "p99_ratio": 1.15,
+                      "shed": 0}) == 0
+    assert synthetic({"tokens_per_s": 250.0, "p99_ratio": 0.4,
+                      "shed": 0}) == 0
+
+
+def test_gate_zero_baseline_lower_pins_any_increase(synthetic):
+    # shed baseline 0 with direction=lower: ANY shed is a failure
+    assert synthetic({"tokens_per_s": 100.0, "p99_ratio": 1.0,
+                      "shed": 1}) == 1
+
+
+def test_gate_fails_on_missing_field_or_artifact(synthetic, tmp_path,
+                                                 capsys):
+    assert synthetic({"tokens_per_s": 100.0, "shed": 0}) == 1
+    assert "missing field" in capsys.readouterr().out
+    (tmp_path / "BENCH_x.json").unlink()
+    assert bench_gate.main(["--baseline", str(tmp_path / "base.json"),
+                            "--root", str(tmp_path)]) == 1
+    assert "unreadable" in capsys.readouterr().out

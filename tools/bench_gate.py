@@ -1,0 +1,153 @@
+"""Bench regression gate: committed artifacts vs committed baseline.
+
+Compares the HEADLINE fields of the committed bench artifacts
+(``BENCH_engine.json``, ``BENCH_serve.json``) against
+``BENCH_BASELINE.json`` and exits non-zero when any field regressed past
+its threshold.  Tier-1 runs it (tests/test_bench_gate.py), so a PR that
+commits a regressed artifact — or forgets to commit one — fails CI
+loudly instead of silently shifting the baseline.
+
+The baseline file declares what "headline" means, per artifact:
+
+    {
+      "threshold": 0.2,
+      "benches": {
+        "BENCH_serve.json": {
+          "overload.tokens_per_s": {"value": 500.0, "direction": "higher"},
+          "interactive_p99_ratio": {"value": 1.0, "direction": "lower"},
+          "overload.classes.interactive.shed": {"value": 0,
+                                                 "direction": "lower"}
+        }
+      }
+    }
+
+* keys are dotted paths into the artifact JSON;
+* ``direction: "higher"`` fails when current < baseline * (1 - threshold);
+* ``direction: "lower"`` fails when current > baseline * (1 + threshold)
+  (a zero baseline makes ANY increase a failure — how the gate pins
+  "interactive is never shed");
+* a per-field ``"threshold"`` overrides the file-level default (0.2).
+
+Missing artifacts, missing fields, or unparsable JSON are FAILURES, not
+skips — the gate's job is to notice exactly that.
+
+Run: ``python tools/bench_gate.py`` (from anywhere; paths resolve
+against the repo root).  ``--threshold`` overrides the file default;
+positional args override which artifacts are checked.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE = "BENCH_BASELINE.json"
+
+
+def _lookup(obj, dotted):
+    """Resolve ``a.b.c`` into nested dicts; raises KeyError on any miss."""
+    cur = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(dotted)
+        cur = cur[part]
+    if not isinstance(cur, (int, float)) or isinstance(cur, bool):
+        raise KeyError(f"{dotted} is not numeric")
+    return float(cur)
+
+
+def check(baseline: dict, root: str, only=None, threshold=None):
+    """Returns (failures, report_lines); failures == [] means gate passes."""
+    failures, lines = [], []
+    default_thr = float(threshold if threshold is not None
+                        else baseline.get("threshold", 0.2))
+    benches = baseline.get("benches")
+    if not isinstance(benches, dict) or not benches:
+        return (["baseline has no 'benches' section"], lines)
+    for artifact, fields in benches.items():
+        if only and artifact not in only:
+            continue
+        path = os.path.join(root, artifact)
+        try:
+            with open(path) as f:
+                current = json.load(f)
+        except (OSError, ValueError) as e:
+            failures.append(f"{artifact}: unreadable ({e})")
+            continue
+        for dotted, spec in fields.items():
+            try:
+                base_val = float(spec["value"])
+                direction = spec["direction"]
+            except (KeyError, TypeError, ValueError):
+                failures.append(
+                    f"{artifact}:{dotted}: malformed baseline spec {spec!r}")
+                continue
+            if direction not in ("higher", "lower"):
+                failures.append(
+                    f"{artifact}:{dotted}: bad direction {direction!r}")
+                continue
+            thr = float(spec.get("threshold", default_thr))
+            try:
+                cur_val = _lookup(current, dotted)
+            except KeyError as e:
+                failures.append(f"{artifact}:{dotted}: missing field ({e})")
+                continue
+            if direction == "higher":
+                limit = base_val * (1.0 - thr)
+                ok = cur_val >= limit
+                want = f">= {limit:.4g}"
+            else:
+                limit = base_val * (1.0 + thr)
+                ok = cur_val <= limit
+                want = f"<= {limit:.4g}"
+            tag = "ok  " if ok else "FAIL"
+            lines.append(
+                f"{tag} {artifact}:{dotted} = {cur_val:.4g} "
+                f"(baseline {base_val:.4g}, {direction}-is-better, "
+                f"want {want})")
+            if not ok:
+                failures.append(
+                    f"{artifact}:{dotted} regressed: {cur_val:.4g} vs "
+                    f"baseline {base_val:.4g} (limit {want})")
+    return failures, lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("artifacts", nargs="*",
+                    help="artifact filenames to check (default: all in the "
+                         "baseline)")
+    ap.add_argument("--baseline", default=os.path.join(REPO, BASELINE))
+    ap.add_argument("--root", default=REPO,
+                    help="directory the artifact paths resolve against")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="override the baseline's default threshold")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: FAIL baseline unreadable: {e}")
+        return 1
+
+    failures, lines = check(baseline, args.root,
+                            only=set(args.artifacts) or None,
+                            threshold=args.threshold)
+    for line in lines:
+        print(f"bench_gate: {line}")
+    if failures:
+        for f in failures:
+            print(f"bench_gate: FAIL {f}")
+        print(f"bench_gate: {len(failures)} failure(s)")
+        return 1
+    print("bench_gate: all headline fields within threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,12 +95,21 @@ class DisaggRouter:
         )
 
     # -- submission ------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: Optional[int] = None
-               ) -> ResponseStream:
+    def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
+               priority: str = "interactive") -> ResponseStream:
         """Queue one prompt through the disaggregated path; the stream is
-        live immediately (tokens start at first-token handoff)."""
+        live immediately (tokens start at first-token handoff).
+        ``priority`` rides through to the decode engine's admission (the
+        handoff itself bypasses a decode-side drain — this router admitted
+        the work before any drain began)."""
         from tpu_air.observability.tracing import current_propagation
 
+        # surface draining at the front door, BEFORE spending prefill work
+        if getattr(self.engine, "draining", False):
+            from ..types import EngineDrainingError
+
+            raise EngineDrainingError(
+                f"decode engine {self.engine.name!r} is draining")
         with self._lock:
             self._rid += 1
             rid = self._rid
@@ -108,7 +117,7 @@ class DisaggRouter:
         carrier = current_propagation()
         t = threading.Thread(
             target=self._dispatch,
-            args=(list(prompt), max_new_tokens, stream, carrier),
+            args=(list(prompt), max_new_tokens, stream, carrier, priority),
             name=f"{self.name}-dispatch-{rid}", daemon=True,
         )
         t.start()
@@ -144,14 +153,28 @@ class DisaggRouter:
         with self._lock:
             return sum(self._alive)
 
+    # -- draining (passthrough to the decode engine) ---------------------------
+    def drain(self) -> None:
+        """Refuse new submits; queued + in-flight work (including handoffs
+        already dispatched) retires normally on the decode engine."""
+        self.engine.drain()
+
+    @property
+    def draining(self) -> bool:
+        return getattr(self.engine, "draining", False)
+
+    def drained(self) -> bool:
+        return self.engine.drained()
+
     # -- the per-request dispatcher -------------------------------------------
-    def _dispatch(self, prompt, max_new, stream, carrier) -> None:
+    def _dispatch(self, prompt, max_new, stream, carrier, priority) -> None:
         try:
-            self._dispatch_inner(prompt, max_new, stream, carrier)
+            self._dispatch_inner(prompt, max_new, stream, carrier, priority)
         except BaseException as e:  # never strand the caller's stream
             stream._finish(e)
 
-    def _dispatch_inner(self, prompt, max_new, stream, carrier) -> None:
+    def _dispatch_inner(self, prompt, max_new, stream, carrier,
+                        priority) -> None:
         import tpu_air
         from tpu_air.observability.tracing import task_span
 
@@ -175,7 +198,12 @@ class DisaggRouter:
             # on the SAME stream — degraded, never dropped
             with self._lock:
                 self.fallbacks += 1
-            self.engine.submit(prompt, max_new, stream=stream)
+            # internal path: like submit_prefilled, a fallback is work this
+            # router ALREADY admitted, so it rides through a decode-side
+            # drain that began mid-dispatch instead of erroring the stream
+            self.engine._enqueue(self.engine._make_request(
+                prompt, max_new, stream, priority,
+                admit_while_draining=True))
             return
         with task_span("engine.kv_transfer", carrier) as sp:
             payload = tpu_air.get(result["kv"])
@@ -189,7 +217,7 @@ class DisaggRouter:
             # parent: decode joins the same trace as prefill + transfer
             self.engine.submit_prefilled(
                 prompt, result["first_token"], payload, max_new,
-                stream=stream)
+                stream=stream, priority=priority)
         with self._lock:
             self.handoffs += 1
 

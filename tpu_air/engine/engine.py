@@ -62,8 +62,10 @@ from .metrics import EngineMetrics, unregister
 from .scheduler import Scheduler
 from .slots import Slot, SlotManager, make_insert_fn
 from .types import (
+    PRIORITIES,
     EngineClosedError,
     EngineConfig,
+    EngineDrainingError,
     EngineOverloadedError,
     Request,
     ResponseStream,
@@ -123,6 +125,8 @@ class InferenceEngine:
         self._id_lock = threading.Lock()
         self._step_lock = threading.Lock()
         self._closed = False
+        self._draining = False
+        self._round_admits = 0  # slots taken during one admission round
         self._thread: Optional[threading.Thread] = None
         if auto_start:
             self.start()
@@ -153,10 +157,24 @@ class InferenceEngine:
         self._prefill_fns: Dict[int, Any] = {}  # bucket -> compiled
 
     # -- submission (any thread) ---------------------------------------------
-    def _make_request(self, prompt, max_new_tokens, stream) -> Request:
-        """Shared validation + Request construction for both submit paths."""
+    def _make_request(self, prompt, max_new_tokens, stream,
+                      priority: str = "interactive", *,
+                      admit_while_draining: bool = False) -> Request:
+        """Shared validation + Request construction for both submit paths.
+
+        ``admit_while_draining`` is the disaggregated-handoff escape hatch:
+        a ``submit_prefilled`` payload was ADMITTED at the router before the
+        drain began — refusing it here would drop work the caller already
+        streamed a first token for."""
         if self._closed:
             raise EngineClosedError("engine is shut down")
+        if self._draining and not admit_while_draining:
+            raise EngineDrainingError(
+                f"engine {self.name!r} is draining; submit elsewhere")
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown priority {priority!r} (expected one of {PRIORITIES})"
+            )
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -174,31 +192,37 @@ class InferenceEngine:
             self._next_request_id += 1
         return Request(request_id=rid, prompt=prompt, max_new_tokens=budget,
                        stream=stream if stream is not None
-                       else ResponseStream(rid))
+                       else ResponseStream(rid),
+                       priority=priority)
 
     def _enqueue(self, req: Request) -> ResponseStream:
         try:
             self.scheduler.submit(req)
         except EngineOverloadedError:  # backpressure: count the 503, surface it
-            self.metrics.record_reject()
+            self.metrics.record_reject(req.priority)
             raise
-        self.metrics.record_submit()
+        self.metrics.record_submit(req.priority)
         return req.stream
 
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None, *,
+               priority: str = "interactive",
                stream: Optional[ResponseStream] = None) -> ResponseStream:
         """Queue one prompt; returns its token stream immediately.
 
+        ``priority`` is the request's SLO class (``types.PRIORITIES``):
+        admission pops interactive first each step, and under backpressure
+        best-effort sheds at half the queue depth interactive does.
         ``stream`` lets a front-end that already handed a stream to its
         caller (the disagg router's prefill-fallback path) have the engine
         emit onto it instead of minting a fresh one."""
         return self._enqueue(self._make_request(prompt, max_new_tokens,
-                                                stream))
+                                                stream, priority))
 
     def submit_prefilled(self, prompt: Sequence[int], first_token: int,
                          kv_pages: Dict[str, Any],
                          max_new_tokens: Optional[int] = None, *,
+                         priority: str = "interactive",
                          stream: Optional[ResponseStream] = None
                          ) -> ResponseStream:
         """Queue a request whose prefill ALREADY RAN elsewhere (a
@@ -212,7 +236,10 @@ class InferenceEngine:
         if not self.paged:
             raise ValueError(
                 "submit_prefilled requires a paged engine (kv_mode='paged')")
-        req = self._make_request(prompt, max_new_tokens, stream)
+        # a handoff rides through a drain: the router admitted it before the
+        # drain started and its prefill already ran on another replica
+        req = self._make_request(prompt, max_new_tokens, stream, priority,
+                                 admit_while_draining=True)
         req.prefilled = {"first_token": int(first_token), "pages": kv_pages}
         return self._enqueue(req)
 
@@ -236,9 +263,9 @@ class InferenceEngine:
         with self._step_lock:
             worked = False
             self._begin_admission_round()
-            can_admit = self._can_admit if self.paged else None
+            self._round_admits = 0
             for req in self.scheduler.pop_admissible(
-                self.slots.free_count(), can_admit
+                self.slots.free_count(), self._admit_gate()
             ):
                 if self.paged:
                     self._admit_paged(req)
@@ -258,12 +285,58 @@ class InferenceEngine:
                     prefill_chunks=self._chunks_run,
                 )
             self.metrics.observe_gauges(
-                self.scheduler.depth(), self.slots.occupancy(), **gauges
+                self.scheduler.depth(), self.slots.occupancy(),
+                queue_by_class=self.scheduler.depth_by_class(),
+                draining=self._draining,
+                **gauges
             )
             return worked
 
     def idle(self) -> bool:
         return self.scheduler.depth() == 0 and self.slots.occupancy() == 0
+
+    # -- draining (zero-downtime rollout / scale-down) ------------------------
+    def drain(self) -> None:
+        """Stop admitting NEW submissions; everything already queued or in a
+        slot retires normally (streaming untouched).  The deployment calls
+        this before swapping/killing a replica; :meth:`drained` answers when
+        the swap may proceed.  Idempotent; :meth:`close` is still required
+        to stop the loop."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drained(self) -> bool:
+        """True once draining AND no admitted work remains."""
+        return self._draining and self.idle()
+
+    def _admit_gate(self):
+        """Per-round admission predicate handed to the scheduler.  Combines
+        the paged page-capacity gate with the interactive slot reserve
+        (``EngineConfig.reserved_interactive_slots``): a non-interactive
+        request may only take a slot while MORE than ``reserved`` slots
+        would stay free after this round's takes — so a lower-class burst
+        can never occupy the whole pool and an arriving interactive request
+        admits immediately.  Returns None (no gate — the scheduler's pure
+        pop) when neither applies, preserving the slab fast path exactly."""
+        page_gate = self._can_admit if self.paged else None
+        reserved = self.config.reserved_interactive_slots
+        if reserved <= 0:
+            return page_gate
+
+        def gate(req: Request) -> bool:
+            if req.priority != "interactive" and (
+                self.slots.free_count() - self._round_admits <= reserved
+            ):
+                return False
+            if page_gate is not None and not page_gate(req):
+                return False
+            self._round_admits += 1
+            return True
+
+        return gate
 
     # -- paged admission -----------------------------------------------------
     def _begin_admission_round(self) -> None:
@@ -320,7 +393,8 @@ class InferenceEngine:
             # t_first == t_admit: the > guard in _emit_request_spans keeps
             # the (remote) prefill from double-reporting as a local span
             req.t_first_ns = req.t_admit_ns
-        self.metrics.record_ttft(req.first_token_at - req.submitted_at)
+        self.metrics.record_ttft(req.first_token_at - req.submitted_at,
+                                 req.priority)
         req.stream._emit(first)
         self.metrics.record_tokens(1)
         self.pool.register(slot.index, req.prompt)
@@ -387,7 +461,8 @@ class InferenceEngine:
         req.first_token_at = time.monotonic()
         if req.t_submit_ns:  # traced request: stamp TTFT for span emission
             req.t_first_ns = _tracing.now_ns()
-        self.metrics.record_ttft(req.first_token_at - req.submitted_at)
+        self.metrics.record_ttft(req.first_token_at - req.submitted_at,
+                                 req.priority)
         req.stream._emit(first)
         self.metrics.record_tokens(1)  # prefill's first token
         self.pool.register(slot.index, req.prompt)
@@ -428,7 +503,8 @@ class InferenceEngine:
         req.first_token_at = time.monotonic()
         if req.t_submit_ns:  # traced request: stamp TTFT for span emission
             req.t_first_ns = _tracing.now_ns()
-        self.metrics.record_ttft(req.first_token_at - req.submitted_at)
+        self.metrics.record_ttft(req.first_token_at - req.submitted_at,
+                                 req.priority)
         req.stream._emit(first)
         self.metrics.record_tokens(1)  # prefill's first token
         slot.request = req

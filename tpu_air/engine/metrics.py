@@ -17,6 +17,8 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict
 
+from .types import PRIORITIES
+
 _WINDOW = 256          # samples kept for the latency distributions
 _RATE_WINDOW_S = 10.0  # tokens/s horizon
 
@@ -50,6 +52,16 @@ class EngineMetrics:
         self.requests_rejected = 0
         self.requests_completed = 0
         self.tokens_emitted = 0
+        # per-priority-class breakdowns (SLO-aware serving): submits/sheds
+        # by class plus a per-class TTFT window, so the interactive p99 the
+        # admission controller and autoscaler steer on is visible directly
+        self.submitted_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        self.rejected_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        self._ttft_by_class: Dict[str, Deque[float]] = {
+            p: deque(maxlen=_WINDOW) for p in PRIORITIES
+        }
+        self.queue_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        self.draining = False
         # distributions / rates
         self._ttft_s: Deque[float] = deque(maxlen=_WINDOW)
         self._step_s: Deque[float] = deque(maxlen=_WINDOW)
@@ -74,7 +86,9 @@ class EngineMetrics:
     def observe_gauges(self, queue_depth: int, slot_occupancy: int,
                        kvpool: Dict[str, Any] = None,
                        reordered_admits: int = None,
-                       prefill_chunks: int = None) -> None:
+                       prefill_chunks: int = None,
+                       queue_by_class: Dict[str, int] = None,
+                       draining: bool = None) -> None:
         with self._lock:
             self.queue_depth = queue_depth
             self.slot_occupancy = slot_occupancy
@@ -84,22 +98,33 @@ class EngineMetrics:
                 self.reordered_admits = reordered_admits
             if prefill_chunks is not None:
                 self.prefill_chunks = prefill_chunks
+            if queue_by_class is not None:
+                self.queue_by_class = dict(queue_by_class)
+            if draining is not None:
+                self.draining = bool(draining)
 
-    def record_submit(self) -> None:
+    def record_submit(self, priority: str = "interactive") -> None:
         with self._lock:
             self.requests_submitted += 1
+            if priority in self.submitted_by_class:
+                self.submitted_by_class[priority] += 1
 
-    def record_reject(self) -> None:
+    def record_reject(self, priority: str = "interactive") -> None:
         with self._lock:
             self.requests_rejected += 1
+            if priority in self.rejected_by_class:
+                self.rejected_by_class[priority] += 1
 
     def record_complete(self) -> None:
         with self._lock:
             self.requests_completed += 1
 
-    def record_ttft(self, seconds: float) -> None:
+    def record_ttft(self, seconds: float,
+                    priority: str = "interactive") -> None:
         with self._lock:
             self._ttft_s.append(seconds)
+            if priority in self._ttft_by_class:
+                self._ttft_by_class[priority].append(seconds)
 
     def record_tokens(self, tokens: int) -> None:
         """Count emitted tokens outside a pool step (prefill's first token)."""
@@ -130,6 +155,8 @@ class EngineMetrics:
             self._ttft_s.clear()
             self._step_s.clear()
             self._token_stamps.clear()
+            for q in self._ttft_by_class.values():
+                q.clear()
 
     # -- dashboard-side ------------------------------------------------------
     def tokens_per_s(self) -> float:
@@ -155,6 +182,16 @@ class EngineMetrics:
                 "tokens_emitted": self.tokens_emitted,
                 "ttft_s": _dist(self._ttft_s),
                 "step_latency_s": _dist(self._step_s),
+                "draining": self.draining,
+                "priority": {
+                    p: {
+                        "submitted": self.submitted_by_class[p],
+                        "shed": self.rejected_by_class[p],
+                        "queue_depth": self.queue_by_class.get(p, 0),
+                        "ttft_s": _dist(self._ttft_by_class[p]),
+                    }
+                    for p in PRIORITIES
+                },
             }
             if self.kvpool:
                 out["kvpool"] = dict(self.kvpool)
@@ -228,6 +265,24 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
         for key in ("reordered_admits", "prefill_chunks"):
             if key in snap:
                 lines.append(f"tpu_air_engine_{key}{tag} {snap[key]}")
+        if "draining" in snap:
+            lines.append(
+                f"tpu_air_engine_draining{tag} {int(bool(snap['draining']))}")
+        # per-priority-class counters/gauges ({engine=...,priority=...})
+        for prio, pc in sorted((snap.get("priority") or {}).items()):
+            ptag = f'{{engine="{label}",priority="{prio}"}}'
+            for key in ("submitted", "shed", "queue_depth"):
+                if key in pc:
+                    lines.append(
+                        f"tpu_air_engine_priority_{key}{ptag} {pc[key]}")
+            d = pc.get("ttft_s") or {}
+            if d.get("count"):
+                lines.append(
+                    f"tpu_air_engine_priority_ttft_s_p50{ptag} "
+                    f"{d['p50']:.6f}")
+                lines.append(
+                    f"tpu_air_engine_priority_ttft_s_p99{ptag} "
+                    f"{d['p99']:.6f}")
         # topology: strings fold into one info line's labels, numbers
         # (replica counts, device counts) become gauges
         topo = snap.get("topology") or {}

@@ -45,7 +45,9 @@ from tpu_air.models.t5.generate import (
 from .metrics import EngineMetrics, unregister
 from .scheduler import Scheduler
 from .types import (
+    PRIORITIES,
     EngineClosedError,
+    EngineDrainingError,
     EngineOverloadedError,
     Request,
     ResponseStream,
@@ -64,6 +66,9 @@ class T5EngineConfig:
       sized to it).
     * ``max_queue`` — queued request cap; beyond it ``submit`` raises
       :class:`EngineOverloadedError`.
+    * ``queue_shares`` — per-priority-class fraction of ``max_queue`` at
+      which submits shed, same contract as
+      :class:`~tpu_air.engine.types.EngineConfig.queue_shares`.
     """
 
     max_batch: int = 4
@@ -71,6 +76,15 @@ class T5EngineConfig:
     max_new_tokens: int = 32
     max_queue: int = 256
     reorder_window: int = 0  # window admission is FIFO; kept for Scheduler
+    queue_shares: Optional[dict] = None
+
+    def queue_cap(self, priority: str) -> int:
+        """Total queue depth at which ``priority``-class submits shed
+        (shares mirror EngineConfig's defaults)."""
+        shares = self.queue_shares or {
+            "interactive": 1.0, "batch": 0.85, "best_effort": 0.5,
+        }
+        return int(self.max_queue * float(shares.get(priority, 1.0)))
 
 
 class _Window:
@@ -112,16 +126,28 @@ class T5Engine:
         self._id_lock = threading.Lock()
         self._step_lock = threading.Lock()
         self._closed = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         if auto_start:
             self.start()
 
     # -- submission (any thread) ---------------------------------------------
     def submit(self, input_ids: Sequence[int],
-               max_new_tokens: Optional[int] = None) -> ResponseStream:
-        """Queue one encoder prompt; returns its token stream immediately."""
+               max_new_tokens: Optional[int] = None, *,
+               priority: str = "interactive") -> ResponseStream:
+        """Queue one encoder prompt; returns its token stream immediately.
+        ``priority`` follows the same SLO-class contract as the causal-LM
+        engine (admission is window-FIFO here, but shed thresholds and
+        per-class gauges still apply)."""
         if self._closed:
             raise EngineClosedError("engine is shut down")
+        if self._draining:
+            raise EngineDrainingError(
+                f"engine {self.name!r} is draining; submit elsewhere")
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown priority {priority!r} (expected one of {PRIORITIES})"
+            )
         prompt = [int(t) for t in input_ids]
         if not prompt:
             raise ValueError("empty prompt")
@@ -142,13 +168,13 @@ class T5Engine:
             self._next_request_id += 1
         stream = ResponseStream(rid)
         req = Request(request_id=rid, prompt=prompt, max_new_tokens=budget,
-                      stream=stream)
+                      stream=stream, priority=priority)
         try:
             self.scheduler.submit(req)
         except EngineOverloadedError:
-            self.metrics.record_reject()
+            self.metrics.record_reject(priority)
             raise
-        self.metrics.record_submit()
+        self.metrics.record_submit(priority)
         return stream
 
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -175,11 +201,27 @@ class T5Engine:
                 self._decode_window()
                 worked = True
             occ = len(self._window.live_rows()) if self._window else 0
-            self.metrics.observe_gauges(self.scheduler.depth(), occ)
+            self.metrics.observe_gauges(
+                self.scheduler.depth(), occ,
+                queue_by_class=self.scheduler.depth_by_class(),
+                draining=self._draining,
+            )
             return worked
 
     def idle(self) -> bool:
         return self.scheduler.depth() == 0 and self._window is None
+
+    # -- draining (same contract as InferenceEngine.drain) -------------------
+    def drain(self) -> None:
+        """Refuse new submits; queued + in-window work retires normally."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drained(self) -> bool:
+        return self._draining and self.idle()
 
     def _open_window(self) -> bool:
         reqs = self.scheduler.pop_admissible(self.config.max_batch)

@@ -17,10 +17,25 @@ from typing import Iterator, List, Optional, Sequence, Tuple, Union
 from tpu_air.core.runtime import TpuAirError
 
 
+#: SLO priority classes, highest first.  Admission pops classes in this
+#: order every engine step (iteration-granularity priority — the Orca
+#: observation applied to admission, not just batching), and the serve
+#: plane's admission controller sheds/queues the tail classes first under
+#: overload (serve/admission.py).
+PRIORITIES = ("interactive", "batch", "best_effort")
+
+
 class EngineOverloadedError(TpuAirError):
     """Admission queue is full — backpressure, not failure.  The serve
     proxy maps this to HTTP 503 (the NoLiveReplicasError semantics): the
     client should retry, nothing is broken."""
+
+
+class EngineDrainingError(EngineOverloadedError):
+    """The engine is draining (zero-downtime rollout / scale-down): new
+    submissions are refused while already-admitted work retires.  Same
+    retry contract as overload — the proxy maps it to 503 and the router
+    has already stopped sending new traffic here."""
 
 
 class EngineClosedError(TpuAirError):
@@ -60,6 +75,17 @@ class EngineConfig:
     * ``reorder_window`` — admission may look this many queue entries past
       a request that does not currently fit (no free KV pages) and admit
       later ones that do.  0 restores strict FIFO.
+    * ``reserved_interactive_slots`` — keep this many FREE slots that only
+      ``interactive``-class requests may take: a burst of batch/best-effort
+      decodes can then never occupy the whole pool, so an arriving
+      interactive request admits (and reaches its first token) without
+      waiting for a lower-class slot to retire.  0 (default) disables the
+      reserve — all classes compete for all slots.
+    * ``queue_shares`` — fraction of ``max_queue`` each priority class may
+      see the TOTAL queue grow to before its submits are rejected
+      (engine-side shed).  Defaults: interactive 1.0, batch 0.85,
+      best_effort 0.5 — as the queue fills, best-effort sheds first,
+      then batch, and interactive keeps the full ``max_queue``.
     * ``prefill_buckets`` — slab mode: prompt-length buckets (ascending);
       prompts right-pad to the smallest fitting bucket so prefill
       compiles once per bucket.  ``None`` → powers of two up to
@@ -80,8 +106,19 @@ class EngineConfig:
     prefix_cache: bool = True
     prefill_chunks_per_step: int = 1
     reorder_window: int = 4
+    reserved_interactive_slots: int = 0
+    queue_shares: Optional[dict] = None
     prefill_buckets: Optional[Tuple[int, ...]] = None
     eos_token_id: Union[int, None, str] = "model"
+
+    _DEFAULT_QUEUE_SHARES = {
+        "interactive": 1.0, "batch": 0.85, "best_effort": 0.5,
+    }
+
+    def queue_cap(self, priority: str) -> int:
+        """Total queue depth at which ``priority``-class submits shed."""
+        shares = self.queue_shares or self._DEFAULT_QUEUE_SHARES
+        return int(self.max_queue * float(shares.get(priority, 1.0)))
 
     def pages_per_slot(self) -> int:
         return -(-self.slot_len // self.page_len)
@@ -176,6 +213,9 @@ class Request:
     prompt: Sequence[int]
     max_new_tokens: int
     stream: ResponseStream
+    # SLO class (one of PRIORITIES): admission pops interactive first each
+    # step, and the scheduler sheds the tail classes at lower queue depths
+    priority: str = "interactive"
     submitted_at: float = field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
     # airtrace: carrier captured at submit + wall-clock stamps (ns) for the

@@ -114,6 +114,20 @@ def engine_stats() -> Dict[str, Any]:
     return out
 
 
+def serve_stats() -> Dict[str, Any]:
+    """Per-route serve-plane control state (the /api/serve payload):
+    admission outcomes + gauges and autoscaler decisions, straight from the
+    proxy's controllers.  Empty when serve isn't running."""
+    try:
+        from tpu_air.serve.proxy import serve_control_stats
+    except Exception:  # noqa: BLE001 — serve package optional
+        return {}
+    try:
+        return serve_control_stats()
+    except Exception:  # noqa: BLE001 — scrape is best-effort
+        return {}
+
+
 def trace_payload(query: Dict[str, Any]) -> Dict[str, Any]:
     """The /api/traces payload: recorder stats + recent trace summaries, or
     one trace's full span list when ``?trace_id=...`` is given."""
@@ -170,6 +184,31 @@ def _prometheus_text() -> str:
             pass
         else:
             lines += prometheus_lines(snapshots)
+    # serve-plane control gauges: admission outcomes per class and the
+    # autoscaler's position, labelled by route
+    for route, ctl in serve_stats().items():
+        adm = ctl.get("admission") or {}
+        for outcome in ("admitted", "queued", "shed"):
+            for klass, n in (adm.get(outcome) or {}).items():
+                lines.append(
+                    f'tpu_air_serve_admission_{outcome}'
+                    f'{{route="{route}",priority="{klass}"}} {n}')
+        g = adm.get("gauges") or {}
+        if g:
+            lines.append(
+                f'tpu_air_serve_queue_depth_per_replica{{route="{route}"}} '
+                f'{g.get("depth_per_replica", 0)}')
+        sc = ctl.get("autoscaler")
+        if sc:
+            lines.append(
+                f'tpu_air_serve_replicas{{route="{route}"}} '
+                f'{sc.get("replicas", 0)}')
+            lines.append(
+                f'tpu_air_serve_scale_ups{{route="{route}"}} '
+                f'{sc.get("scale_ups", 0)}')
+            lines.append(
+                f'tpu_air_serve_scale_downs{{route="{route}"}} '
+                f'{sc.get("scale_downs", 0)}')
     return "\n".join(lines) + "\n"
 
 
@@ -178,6 +217,7 @@ _INDEX_HTML = """<!doctype html><html><head><title>tpu_air dashboard</title></he
 <p>JSON endpoints: <a href="/api/cluster">/api/cluster</a> ·
 <a href="/api/objects">/api/objects</a> ·
 <a href="/api/engines">/api/engines</a> ·
+<a href="/api/serve">/api/serve</a> ·
 <a href="/api/traces">/api/traces</a> ·
 <a href="/api/traces/export">/api/traces/export</a> ·
 <a href="/api/version">/api/version</a> ·
@@ -218,6 +258,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(object_stats()).encode(), "application/json")
             elif path == "/api/engines":
                 self._send(200, json.dumps(engine_stats()).encode(), "application/json")
+            elif path == "/api/serve":
+                self._send(200, json.dumps(serve_stats()).encode(), "application/json")
             elif path == "/api/traces":
                 self._send(200, json.dumps(trace_payload(query)).encode(),
                            "application/json")

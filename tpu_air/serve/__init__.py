@@ -12,28 +12,38 @@ routing round-robin across replicas (cc-79: "a managed group of Ray actors
 that ... handle requests load-balanced across them").
 """
 
+from .admission import AdmissionController, AdmissionPolicy, AdmissionShedError
+from .autoscaler import Autoscaler, AutoscalerConfig
 from .deployment import (
     Application,
     Deployment,
     DeploymentHandle,
     NoLiveReplicasError,
+    ReplicaGoneError,
     deployment,
 )
 from .engine_deployment import EngineDeployment
 from .http_adapters import json_request, pandas_read_json
 from .predictor_deployment import PredictorDeployment
-from .proxy import run, shutdown, status
+from .proxy import rollout, run, shutdown, status
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionPolicy",
+    "AdmissionShedError",
     "Application",
+    "Autoscaler",
+    "AutoscalerConfig",
     "Deployment",
     "DeploymentHandle",
     "EngineDeployment",
     "NoLiveReplicasError",
     "PredictorDeployment",
+    "ReplicaGoneError",
     "deployment",
     "json_request",
     "pandas_read_json",
+    "rollout",
     "run",
     "shutdown",
     "status",

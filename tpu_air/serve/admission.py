@@ -1,0 +1,232 @@
+"""SLO-aware admission for the serve proxy.
+
+The proxy used to answer overload with a binary 503 (whatever the engine's
+admission queue said).  This module moves the decision UP to the serve
+plane, where it can be class-aware and gauge-driven:
+
+* every request carries a priority class (``interactive`` / ``batch`` /
+  ``best_effort``, :data:`tpu_air.engine.types.PRIORITIES`) and gets a
+  per-class TOKEN BUDGET clamp (a best-effort client cannot reserve a
+  1000-token decode during a surge);
+* the controller scrapes the deployment's live engine gauges
+  (``DeploymentHandle.engine_stats`` — queue depth, slot occupancy, KV
+  pressure) on a short TTL and turns them into one scalar: mean queued
+  depth per live replica;
+* under pressure the TAIL classes degrade first — best-effort starts
+  QUEUEING at ``queue_soft`` (the request waits proxy-side, bounded by its
+  class's ``queue_timeout_s``) and SHEDS at ``queue_high``; batch queues
+  at ``queue_high`` and sheds at ``queue_hard``; interactive is admitted
+  at every depth this controller sees (its own ceiling is the engine's
+  class-aware queue cap).  Shed responses are 503 + ``Retry-After``.
+
+The same scrape feeds the handle's least-loaded routing — the handle
+records per-replica loads as a side effect of ``engine_stats`` — so one
+gauge pass serves admission, routing and the autoscaler.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from tpu_air.core.runtime import TpuAirError
+from tpu_air.engine.types import PRIORITIES
+
+#: default per-class max_new_tokens clamps (requests asking for more are
+#: trimmed, not refused — the stream just ends at the budget)
+_DEFAULT_TOKEN_BUDGETS = {
+    "interactive": 256,
+    "batch": 1024,
+    "best_effort": 512,
+}
+
+#: default proxy-side queue waits before a "queue" decision becomes a shed
+_DEFAULT_QUEUE_TIMEOUTS = {
+    "interactive": 0.0,   # interactive never waits at the proxy
+    "batch": 2.0,
+    "best_effort": 5.0,
+}
+
+
+class AdmissionShedError(TpuAirError):
+    """The admission controller refused this request (overload).  Maps to
+    HTTP 503 + ``Retry-After`` — same retry contract as engine
+    backpressure, decided one hop earlier and class-aware."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+@dataclass(frozen=True)
+class AdmissionPolicy:
+    """Dials for one route's admission controller.
+
+    Depth thresholds are MEAN QUEUED REQUESTS PER LIVE REPLICA (engine
+    admission queue depth, from ``engine_stats``), so they keep meaning
+    as the autoscaler changes the replica count:
+
+    * ``queue_soft`` — best-effort starts queueing proxy-side;
+    * ``queue_high`` — best-effort sheds; batch starts queueing;
+    * ``queue_hard`` — batch sheds (interactive still admits — the
+      engine's own class-aware cap is its ceiling).
+
+    ``token_budgets`` clamps per-request ``max_new_tokens`` by class;
+    ``queue_timeout_s`` bounds the proxy-side wait before a queued class
+    sheds; ``stats_ttl_s`` is the gauge-scrape cache horizon (stale stats
+    also disable least-loaded routing in the handle); ``retry_after_s``
+    rides back on shed responses as the ``Retry-After`` header."""
+
+    token_budgets: Dict[str, int] = field(
+        default_factory=lambda: dict(_DEFAULT_TOKEN_BUDGETS))
+    queue_soft: float = 4.0
+    queue_high: float = 12.0
+    queue_hard: float = 32.0
+    queue_timeout_s: Dict[str, float] = field(
+        default_factory=lambda: dict(_DEFAULT_QUEUE_TIMEOUTS))
+    queue_poll_s: float = 0.05
+    retry_after_s: float = 1.0
+    stats_ttl_s: float = 0.25
+
+    def clamp_budget(self, priority: str,
+                     max_new_tokens: Optional[int]) -> Optional[int]:
+        """The effective decode budget for one request of this class.  An
+        UNSET request stays unset — the engine config's own default (sized
+        to its slots) governs; the class budget only trims explicit asks."""
+        cap = self.token_budgets.get(priority)
+        if cap is None or max_new_tokens is None:
+            return max_new_tokens
+        return min(int(max_new_tokens), int(cap))
+
+
+class AdmissionController:
+    """Per-route admission: gauges in, admit/queue/shed out.
+
+    One controller serves one route prefix (one
+    :class:`~tpu_air.serve.deployment.DeploymentHandle`).  The proxy asks
+    :meth:`admit` before forwarding any NEW work (blocking HTTP generate
+    or a streaming ``submit`` action); polls of already-admitted requests
+    bypass admission entirely."""
+
+    def __init__(self, handle, policy: Optional[AdmissionPolicy] = None):
+        self._handle = handle
+        self.policy = policy or AdmissionPolicy()
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, Any] = {}
+        self._gauges_at = 0.0
+        # per-class outcome counters (surface on /-/stats + /metrics)
+        self.admitted = {p: 0 for p in PRIORITIES}
+        self.queued = {p: 0 for p in PRIORITIES}
+        self.shed = {p: 0 for p in PRIORITIES}
+
+    # -- gauges ---------------------------------------------------------------
+    def gauges(self, force: bool = False) -> Dict[str, Any]:
+        """TTL-cached scrape of the route's engine gauges, reduced to the
+        scalars admission steers on.  The same pass pushes per-replica
+        loads into the handle (least-loaded routing) — stale gauges mean
+        the handle falls back to round-robin on its own."""
+        now = time.monotonic()
+        with self._lock:
+            fresh = (now - self._gauges_at) <= self.policy.stats_ttl_s
+            if fresh and not force:
+                return dict(self._gauges)
+        snaps = {}
+        try:
+            snaps = self._handle.engine_stats(timeout=5.0)
+        except Exception:  # noqa: BLE001 — scrape is best-effort; admit on no data
+            snaps = {}
+        live = max(self._handle.num_replicas(), 1)
+        depth = sum(int(s.get("queue_depth", 0)) for s in snaps.values())
+        occupancy = sum(int(s.get("slot_occupancy", 0)) for s in snaps.values())
+        draining = sum(1 for s in snaps.values() if s.get("draining"))
+        gauges = {
+            "replicas": live,
+            "queue_depth": depth,
+            "depth_per_replica": depth / live,
+            "slot_occupancy": occupancy,
+            "draining_replicas": draining,
+            "scraped_engines": len(snaps),
+        }
+        with self._lock:
+            self._gauges = gauges
+            self._gauges_at = time.monotonic()
+        return dict(gauges)
+
+    # -- the decision ---------------------------------------------------------
+    def decide(self, priority: str,
+               gauges: Optional[Dict[str, Any]] = None) -> str:
+        """Pure policy: ``"admit"`` / ``"queue"`` / ``"shed"`` for one
+        request of ``priority`` class under ``gauges`` (defaults to a
+        fresh TTL scrape).  No counters, no waiting — unit-testable."""
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown priority {priority!r} (expected one of {PRIORITIES})"
+            )
+        g = self.gauges() if gauges is None else gauges
+        d = float(g.get("depth_per_replica", 0.0))
+        p = self.policy
+        if priority == "interactive":
+            return "admit"  # its ceiling is the engine's class-aware cap
+        if priority == "batch":
+            if d >= p.queue_hard:
+                return "shed"
+            if d >= p.queue_high:
+                return "queue"
+            return "admit"
+        # best_effort
+        if d >= p.queue_high:
+            return "shed"
+        if d >= p.queue_soft:
+            return "queue"
+        return "admit"
+
+    def admit(self, priority: str) -> None:
+        """Admit-or-raise for one new request: a "queue" decision waits
+        proxy-side (re-scraping each poll) up to the class's
+        ``queue_timeout_s``, then sheds.  Raises
+        :class:`AdmissionShedError` on shed; returns normally on admit."""
+        decision = self.decide(priority)
+        if decision == "admit":
+            with self._lock:
+                self.admitted[priority] += 1
+            return
+        p = self.policy
+        if decision == "queue":
+            with self._lock:
+                self.queued[priority] += 1
+            deadline = time.monotonic() + float(
+                p.queue_timeout_s.get(priority, 0.0))
+            while time.monotonic() < deadline:
+                time.sleep(p.queue_poll_s)
+                decision = self.decide(priority)
+                if decision == "admit":
+                    with self._lock:
+                        self.admitted[priority] += 1
+                    return
+                if decision == "shed":
+                    break
+        with self._lock:
+            self.shed[priority] += 1
+        raise AdmissionShedError(
+            f"{priority}-class shed at the proxy "
+            f"(queue depth/replica past policy thresholds)",
+            retry_after_s=p.retry_after_s,
+        )
+
+    # -- observability --------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "policy": {
+                    "queue_soft": self.policy.queue_soft,
+                    "queue_high": self.policy.queue_high,
+                    "queue_hard": self.policy.queue_hard,
+                    "token_budgets": dict(self.policy.token_budgets),
+                },
+                "admitted": dict(self.admitted),
+                "queued": dict(self.queued),
+                "shed": dict(self.shed),
+                "gauges": dict(self._gauges),
+            }

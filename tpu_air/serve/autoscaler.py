@@ -1,0 +1,193 @@
+"""Gauge-driven replica autoscaling for a serve deployment.
+
+The :class:`Autoscaler` is a DRIVER-SIDE control loop (a daemon thread in
+the proxy's process, NOT an actor — nothing here blocks a worker message
+loop) that scales one deployment between ``min_replicas`` and
+``max_replicas`` on two signals from the live engine gauges:
+
+* **queue pressure** — mean engine admission-queue depth per live replica
+  at or above ``scale_up_queue_depth`` means arrivals outrun service:
+  add a replica (a new actor + chip lease through the runtime's normal
+  placement path — ``DeploymentHandle.scale_up``).
+* **TTFT budget** — when ``ttft_budget_s`` is set and the interactive
+  class's observed p99 TTFT exceeds it, scale up even if queues look
+  shallow (latency is the SLO, queue depth only its proxy).
+
+Scale-DOWN is deliberately timid: only after ``scale_down_idle_ticks``
+CONSECUTIVE ticks with empty queues and zero slot occupancy, and never
+below ``min_replicas``.  A scale-down drains the victim replica first
+(``DeploymentHandle.scale_down`` → drain → lease release), so in-flight
+streams never notice.  ``cooldown_s`` separates consecutive scaling
+actions in either direction — one decision gets to take effect before
+the next is made.
+
+``gauge_source`` is injectable (any callable returning
+``DeploymentHandle.engine_stats``-shaped snapshots), which is how the
+unit tests drive :meth:`tick` against synthetic gauges without replicas.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from time import monotonic
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass(frozen=True)
+class AutoscalerConfig:
+    """Dials for one deployment's autoscaler.
+
+    * ``min_replicas`` / ``max_replicas`` — the scaling envelope.
+    * ``scale_up_queue_depth`` — mean queued requests per live replica
+      that triggers a scale-up.
+    * ``ttft_budget_s`` — optional interactive p99 TTFT ceiling; observed
+      p99 above it also triggers a scale-up.  None disables the signal.
+    * ``scale_down_idle_ticks`` — consecutive idle ticks (queues empty,
+      slots empty) before one replica is drained away.
+    * ``tick_s`` — control-loop period.
+    * ``cooldown_s`` — minimum spacing between scaling actions.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_queue_depth: float = 8.0
+    ttft_budget_s: Optional[float] = None
+    scale_down_idle_ticks: int = 10
+    tick_s: float = 0.5
+    cooldown_s: float = 5.0
+
+
+class Autoscaler:
+    """One deployment's scaling loop (see module doc)."""
+
+    def __init__(self, handle, config: Optional[AutoscalerConfig] = None, *,
+                 gauge_source: Optional[Callable[[], Dict[str, Any]]] = None):
+        self._handle = handle
+        self.config = config or AutoscalerConfig()
+        if self.config.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.config.max_replicas < self.config.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self._gauge_source = gauge_source or handle.engine_stats
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._idle_ticks = 0
+        self._last_action_at = -1e18  # monotonic stamp of the last scale
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.last_decision = "hold"
+
+    # -- pure policy ----------------------------------------------------------
+    def decide(self, snapshots: Dict[str, Dict[str, Any]],
+               replicas: int) -> str:
+        """``"up"`` / ``"down"`` / ``"hold"`` for one tick's gauges.  Pure
+        (no side effects, no cooldown) — the unit-testable core.
+
+        The idle streak that gates scale-down is tracked by :meth:`tick`;
+        this method only answers whether THIS tick looks idle (``"down"``
+        here means "idle and above min", which tick() demotes to hold
+        until the streak is long enough)."""
+        cfg = self.config
+        if replicas < cfg.min_replicas:
+            return "up"
+        depth = sum(int(s.get("queue_depth", 0)) for s in snapshots.values())
+        occupancy = sum(int(s.get("slot_occupancy", 0))
+                        for s in snapshots.values())
+        if replicas < cfg.max_replicas:
+            if depth / max(replicas, 1) >= cfg.scale_up_queue_depth:
+                return "up"
+            if cfg.ttft_budget_s is not None:
+                p99 = self._interactive_p99(snapshots)
+                if p99 is not None and p99 > cfg.ttft_budget_s:
+                    return "up"
+        if replicas > cfg.min_replicas and depth == 0 and occupancy == 0:
+            return "down"
+        return "hold"
+
+    @staticmethod
+    def _interactive_p99(snapshots: Dict[str, Dict[str, Any]]
+                         ) -> Optional[float]:
+        """Worst interactive-class p99 TTFT across replicas, None when no
+        replica has interactive samples yet."""
+        worst = None
+        for s in snapshots.values():
+            d = ((s.get("priority") or {}).get("interactive") or {}).get(
+                "ttft_s") or {}
+            if d.get("count"):
+                p99 = float(d["p99"])
+                worst = p99 if worst is None else max(worst, p99)
+        return worst
+
+    # -- the loop -------------------------------------------------------------
+    def tick(self) -> str:
+        """One control iteration: scrape, decide, maybe act.  Returns the
+        ACTION taken (``"up"`` / ``"down"`` / ``"hold"``)."""
+        cfg = self.config
+        try:
+            snapshots = self._gauge_source() or {}
+        except Exception:  # noqa: BLE001 — a failed scrape must not kill the loop
+            snapshots = {}
+        replicas = self._handle.num_replicas()
+        decision = self.decide(snapshots, replicas)
+        # the idle streak: only an unbroken run of idle ticks earns a
+        # scale-down; any non-idle tick resets it
+        if decision == "down":
+            self._idle_ticks += 1
+            if self._idle_ticks < cfg.scale_down_idle_ticks:
+                decision = "hold"
+        else:
+            self._idle_ticks = 0
+        self.last_decision = decision
+        if decision == "hold":
+            return "hold"
+        now = monotonic()
+        if now - self._last_action_at < cfg.cooldown_s:
+            return "hold"
+        if decision == "up":
+            if self._handle.scale_up():
+                self.scale_ups += 1
+                self._last_action_at = monotonic()
+                return "up"
+            return "hold"
+        # down: drain + release; blocking here is fine (driver-side thread)
+        if self._handle.scale_down():
+            self.scale_downs += 1
+            self._idle_ticks = 0
+            self._last_action_at = monotonic()
+            return "down"
+        return "hold"
+
+    def _loop(self) -> None:
+        # Event.wait as the tick timer: stop() interrupts a sleeping loop
+        # immediately instead of waiting out the period
+        while not self._stop.wait(self.config.tick_s):
+            self.tick()
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"serve-autoscaler-{self._handle.deployment_name}",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # -- observability --------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "min_replicas": self.config.min_replicas,
+            "max_replicas": self.config.max_replicas,
+            "replicas": self._handle.num_replicas(),
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "idle_ticks": self._idle_ticks,
+            "last_decision": self.last_decision,
+        }

@@ -6,19 +6,27 @@ step + background loop), or a :class:`tpu_air.engine.T5Engine` when the
 ``engine_config`` is a :class:`~tpu_air.engine.T5EngineConfig` (the config
 type selects the engine family).  Two client surfaces:
 
-* blocking HTTP: ``POST {"prompts": [[ids...], ...], "max_new_tokens": n}``
-  → ``{"results": [{"request_id": ..., "tokens": [...]}, ...]}`` — every
-  prompt is submitted up front so they share slot-pool steps, then joined.
-* streaming over actor RPC: ``handle.method("submit")(prompt)`` →
-  request id, then ``handle.method("poll")(rid, cursor)`` →
-  ``{"tokens": <new since cursor>, "done": bool}`` — polling cursor
-  streaming, the shape HTTP long-poll clients want (the proxy itself is
-  plain request/response).
+* blocking HTTP: ``POST {"prompts": [[ids...], ...], "max_new_tokens": n,
+  "priority": "interactive"}`` → ``{"results": [{"request_id": ...,
+  "tokens": [...]}, ...]}`` — every prompt is submitted up front so they
+  share slot-pool steps, then joined.
+* streaming over HTTP (action payloads): ``POST {"action": "submit",
+  "prompt": [ids...], "priority": ...}`` → ``{"request_id": rid}``
+  immediately (no blocking — the actor's message loop stays free), then
+  ``POST {"action": "poll", "request_id": rid, "cursor": c}`` →
+  ``{"tokens": <new since cursor>, "done": bool}``.  Polls must land on
+  the replica that took the submit — the proxy round-trips the replica
+  tag in the ``x-tpu-air-replica`` header and pins polls to it.  The same
+  submit/poll pair is also callable over actor RPC
+  (``handle.method("submit")(...)``).
 
 Backpressure: a full admission queue raises
-:class:`~tpu_air.engine.EngineOverloadedError` inside the replica; it
-crosses the actor boundary as ``RemoteError`` and the proxy maps it to
-HTTP 503 (same retry semantics as ``NoLiveReplicasError``).
+:class:`~tpu_air.engine.EngineOverloadedError` inside the replica (class-
+aware — best-effort sheds at a lower queue depth than interactive); a
+DRAINING replica (zero-downtime rollout) raises ``EngineDrainingError``
+for new submits while admitted streams keep polling.  Both cross the
+actor boundary as ``RemoteError`` and the proxy maps them to HTTP 503
+(same retry semantics as ``NoLiveReplicasError``).
 """
 
 from __future__ import annotations
@@ -60,6 +68,10 @@ class _EngineServer:
         self._engine = None
         self._router = None
         self._streams: Dict[int, Any] = {}
+        # recently retired streams' full token lists: a poll AFTER the one
+        # that delivered `done` still answers (insertion-ordered, bounded)
+        self._finished: Dict[int, list] = {}
+        self._draining = False
 
     def _ensure_engine(self):
         if self._engine is None:
@@ -123,13 +135,29 @@ class _EngineServer:
         self._ensure_engine()
         return self._router if self._router is not None else self._engine
 
-    # -- blocking HTTP path ---------------------------------------------------
+    # -- HTTP path (blocking generate + streaming actions) --------------------
     def __call__(self, payload) -> Dict[str, Any]:
         if not isinstance(payload, dict):
             raise ValueError(
                 'expected JSON object {"prompts": [[ids...], ...]} '
-                '(or {"prompt": [ids...]})'
+                '(or {"prompt": [ids...]}, or {"action": "submit"/"poll"})'
             )
+        # streaming actions: fast, non-blocking RPCs — the actor's serial
+        # message loop turns around immediately, so MANY clients can hold
+        # concurrent streams against one replica (continuous batching is
+        # only observable end-to-end through this path)
+        action = payload.get("action")
+        if action == "submit":
+            return {"request_id": self.submit(
+                payload.get("prompt") or [],
+                payload.get("max_new_tokens"),
+                priority=payload.get("priority", "interactive"),
+            )}
+        if action == "poll":
+            return self.poll(int(payload.get("request_id", -1)),
+                             int(payload.get("cursor", 0)))
+        if action is not None:
+            raise ValueError(f"unknown action {action!r}")
         if "prompt" in payload:
             prompts = [payload["prompt"]]
         else:
@@ -137,9 +165,11 @@ class _EngineServer:
         if not prompts:
             raise ValueError('payload needs "prompt" or a non-empty "prompts"')
         max_new = payload.get("max_new_tokens")
+        priority = payload.get("priority", "interactive")
         front = self._front()
         # submit ALL before joining ANY — concurrent prompts share pool steps
-        streams = [front.submit(p, max_new) for p in prompts]
+        streams = [front.submit(p, max_new, priority=priority)
+                   for p in prompts]
         return {
             "results": [
                 {"request_id": s.request_id,
@@ -148,21 +178,62 @@ class _EngineServer:
             ]
         }
 
-    # -- streaming path (actor RPC) -------------------------------------------
-    def submit(self, prompt, max_new_tokens: Optional[int] = None) -> int:
-        stream = self._front().submit(prompt, max_new_tokens)
+    # -- streaming path (HTTP actions above, or direct actor RPC) -------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
+               priority: str = "interactive") -> int:
+        stream = self._front().submit(prompt, max_new_tokens,
+                                      priority=priority)
         self._streams[stream.request_id] = stream
         return stream.request_id
 
     def poll(self, request_id: int, cursor: int = 0) -> Dict[str, Any]:
         stream = self._streams.get(request_id)
         if stream is None:
-            raise KeyError(f"unknown request_id {request_id}")
-        toks = stream.tokens_so_far()
+            toks = self._finished.get(request_id)
+            if toks is None:
+                raise KeyError(f"unknown request_id {request_id}")
+            return {"tokens": toks[cursor:], "done": True}
+        # read `done` BEFORE the tokens: done observed first guarantees the
+        # token list is complete, so a client may stop at its first done
+        # response without losing a tail emitted between the two reads
         done = stream.done
-        if done and len(toks) <= cursor:
-            self._streams.pop(request_id, None)  # fully drained
+        toks = stream.tokens_so_far()
+        if done:
+            # delivery completes with this response; move the stream to the
+            # bounded tombstone map so drain_status stops counting it but a
+            # trailing confirmation poll still answers
+            self._streams.pop(request_id, None)
+            self._finished[request_id] = toks
+            while len(self._finished) > 512:
+                self._finished.pop(next(iter(self._finished)))
         return {"tokens": toks[cursor:], "done": done}
+
+    # -- draining (zero-downtime rollout / scale-down) ------------------------
+    def drain(self) -> None:
+        """Stop admitting new work; admitted streams retire and stay
+        pollable.  Never forces the lazy engine build — a replica that
+        served nothing drains instantly."""
+        self._draining = True
+        front = self._router if self._router is not None else self._engine
+        if front is not None:
+            front.drain()
+
+    def drain_status(self) -> Dict[str, Any]:
+        """``drained`` means: drain was requested, the engine retired all
+        admitted work, and every finished stream was polled to its end
+        (the deployment kills the replica only then — no client loses a
+        tail it hasn't read)."""
+        # drop fully-delivered streams a client finished mid-drain but
+        # never polled past the end of
+        pending = len(self._streams)
+        engine_done = (self._engine is None
+                       or (self._engine.drained() if self._draining
+                           else False))
+        return {
+            "draining": self._draining,
+            "pending_streams": pending,
+            "drained": bool(self._draining and engine_done and pending == 0),
+        }
 
     def stats(self) -> Dict[str, Any]:
         # a dashboard scrape must NEVER force the lazy engine build (model

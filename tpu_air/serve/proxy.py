@@ -1,9 +1,26 @@
 """HTTP proxy + serve.run/shutdown.
 
 Request path (SURVEY.md §3.5): client POST :8000 → proxy → route match →
-round-robin replica actor → http_adapter(body) → predictor/callable →
+SLO admission (priority class + token budget, serve/admission.py) →
+least-loaded replica actor → http_adapter(body) → predictor/callable →
 JSON response.  The proxy is a threaded HTTP server owned by the driver
 process (the "HTTP proxy actor" of the reference, cc-71,74,79).
+
+Serving contract under load (docs/SERVING.md §SLO-aware serving):
+
+* new work (blocking generate, or a streaming ``{"action": "submit"}``)
+  passes the route's :class:`~tpu_air.serve.admission.AdmissionController`
+  first — best-effort/batch queue proxy-side or shed (503 +
+  ``Retry-After``) as engine queue depth climbs, interactive admits;
+* streaming polls BYPASS admission (the work is already admitted) and PIN
+  to the replica that took the submit via the ``x-tpu-air-replica``
+  header, which the proxy round-trips on every routed response;
+* replica-side backpressure (``EngineOverloadedError``) and drain refusal
+  (``EngineDrainingError``) both map to 503 — retry semantics, nothing
+  broken;
+* ``serve.rollout(prefix)`` swaps every replica zero-downtime (drain
+  before kill — pinned polls keep landing on the draining replica until
+  its streams are fully delivered).
 """
 
 from __future__ import annotations
@@ -17,12 +34,19 @@ from tpu_air.core import api as core_api
 from tpu_air.core.runtime import RemoteError
 from tpu_air.observability import tracing as _tracing
 
+from .admission import AdmissionController, AdmissionPolicy, AdmissionShedError
+from .autoscaler import Autoscaler, AutoscalerConfig
 from .deployment import (
     Application,
     DeploymentHandle,
     NoLiveReplicasError,
+    ReplicaGoneError,
     start_replicas,
 )
+
+#: request header that pins streaming polls to the replica holding their
+#: stream; the proxy sets it on every routed response
+REPLICA_HEADER = "x-tpu-air-replica"
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -53,33 +77,49 @@ def _to_jsonable(obj: Any) -> Any:
 class _ServeState:
     def __init__(self):
         self.routes: Dict[str, DeploymentHandle] = {}
+        self.admission: Dict[str, AdmissionController] = {}
+        self.autoscalers: Dict[str, Autoscaler] = {}
         self.server: Optional[ThreadingHTTPServer] = None
         self.thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
         self.lock = threading.Lock()
 
-    def match(self, path: str) -> Optional[DeploymentHandle]:
+    def match(self, path: str):
+        """Longest-prefix route match → ``(prefix, handle)`` (the prefix
+        keys the route's admission controller/autoscaler), or None."""
         best = None
         for prefix, handle in self.routes.items():
             norm = prefix.rstrip("/") or "/"
             if path == norm or path.startswith(norm + "/") or norm == "/":
-                if best is None or len(norm) > len(best[0]):
-                    best = (norm, handle)
-        return best[1] if best else None
+                if best is None or len(norm) > len(best[2]):
+                    best = (prefix, handle, norm)
+        return (best[0], best[1]) if best else None
 
 
 _state = _ServeState()
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.1 keep-alive: streaming clients poll at high frequency, and a
+    # connection per poll costs a proxy thread spawn each (ThreadingHTTPServer
+    # is thread-per-CONNECTION) — persistent connections amortize it to one
+    # thread per client.  Safe because _respond always sends Content-Length.
+    # Nagle must be off or small responses on the reused socket wait out the
+    # peer's delayed ACK (~40ms per poll).
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
+
     def log_message(self, *args):  # quiet
         pass
 
-    def _respond(self, code: int, payload: Any):
+    def _respond(self, code: int, payload: Any,
+                 headers: Optional[Dict[str, str]] = None):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         # surface the request's trace to the client: curl the trace id
         # straight into /api/traces?trace_id=... (docs/OBSERVABILITY.md)
         ctx = _tracing.current_context()
@@ -123,27 +163,68 @@ class _Handler(BaseHTTPRequestHandler):
                 {"status": "ok" if healthy else "degraded", "deployments": detail},
             )
             return
-        handle = _state.match(self.path)
-        if handle is None:
+        if self.path.rstrip("/") == "/-/stats":
+            # serve-plane control state per route: admission outcomes and
+            # gauges, autoscaler decisions (docs/OBSERVABILITY.md)
+            self._respond(200, serve_control_stats())
+            return
+        matched = _state.match(self.path)
+        if matched is None:
             self._respond(404, {"error": f"no deployment for route {self.path!r}"})
             return
+        prefix, handle = matched
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
+        pin = None
         try:
+            try:
+                payload = json.loads(body) if body else None
+            except ValueError:
+                payload = None  # non-JSON body: the replica's adapter decides
+            if isinstance(payload, dict):
+                action = payload.get("action")
+                if action == "poll":
+                    # already-admitted work: no admission, and the poll must
+                    # land on the replica holding the stream's state
+                    pin = self.headers.get(REPLICA_HEADER) or None
+                else:
+                    controller = _state.admission.get(prefix)
+                    if controller is not None:
+                        priority = str(
+                            payload.get("priority") or "interactive")
+                        controller.admit(priority)  # raises on shed
+                        clamped = controller.policy.clamp_budget(
+                            priority, payload.get("max_new_tokens"))
+                        if clamped is not None and clamped != payload.get(
+                                "max_new_tokens"):
+                            payload["max_new_tokens"] = clamped
+                            body = json.dumps(payload).encode()
             # failover path: replica death mid-request retries on a live
-            # replica; only application errors surface as 500
-            result = handle.call_http_sync(body, timeout=300.0)
-            self._respond(200, _to_jsonable(result))
-        except NoLiveReplicasError as e:
+            # replica; only application errors surface as 500.  The serving
+            # replica's tag rides back so streaming clients can pin polls.
+            result, tag = handle.call_http_sync_tagged(
+                body, timeout=300.0, pin=pin)
+            self._respond(200, _to_jsonable(result),
+                          headers={REPLICA_HEADER: tag})
+        except AdmissionShedError as e:
+            self._respond(503, {"error": f"AdmissionShedError: {e}"},
+                          headers={"Retry-After": f"{e.retry_after_s:g}"})
+        except (NoLiveReplicasError, ReplicaGoneError) as e:
             self._respond(503, {"error": str(e)})
         except RemoteError as e:
-            # replica-side backpressure (engine admission queue full) is the
-            # same "retry later, nothing is broken" contract as zero live
+            # replica-side backpressure (engine admission queue full) and
+            # drain refusal (replica retiring mid-rollout) are the same
+            # "retry later, nothing is broken" contract as zero live
             # replicas — 503, not 500
-            if e.cause_repr.startswith("EngineOverloadedError"):
+            if e.cause_repr.startswith(("EngineOverloadedError",
+                                        "EngineDrainingError")):
                 self._respond(503, {"error": e.cause_repr})
             else:
                 self._respond(500, {"error": f"{type(e).__name__}: {e}"})
+        except ValueError as e:
+            # malformed request (bad priority / bad payload shape caught
+            # proxy-side): client error, not server error
+            self._respond(400, {"error": f"ValueError: {e}"})
         except Exception as e:  # noqa: BLE001 — surface the error to the client
             self._respond(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -158,10 +239,19 @@ def run(
     port: int = 8000,
     name: Optional[str] = None,
     route_prefix: Optional[str] = None,
+    admission_policy: Optional[AdmissionPolicy] = None,
+    autoscaler: Optional[AutoscalerConfig] = None,
     _blocking: bool = False,
     **_ignored,
 ) -> DeploymentHandle:
-    """Deploy an Application: start its replicas and route HTTP to them."""
+    """Deploy an Application: start its replicas and route HTTP to them.
+
+    Every route gets an :class:`~tpu_air.serve.admission.AdmissionController`
+    (``admission_policy`` overrides the default
+    :class:`~tpu_air.serve.admission.AdmissionPolicy`; routes without an
+    engine see empty gauges and admit everything, so plain deployments are
+    unaffected).  Passing ``autoscaler=AutoscalerConfig(...)`` additionally
+    starts a gauge-driven replica scaling loop for this route."""
     if not isinstance(target, Application):
         raise TypeError(
             "serve.run expects a bound Application — call Deployment.bind(...)"
@@ -177,7 +267,12 @@ def run(
             )
     handle = start_replicas(target)
     old = None
+    old_scaler = None
     try:
+        # validate the autoscaler config (and build the loop) BEFORE any
+        # route-table mutation: a bad config must not half-deploy
+        scaler = (Autoscaler(handle, autoscaler)
+                  if autoscaler is not None else None)
         with _state.lock:
             # re-check under the same lock that creates the server — the
             # early check above is only a fast-fail; this one is authoritative
@@ -194,10 +289,17 @@ def run(
                 thread.start()
                 _state.server, _state.thread, _state.port = server, thread, port
             old = _state.routes.get(prefix)
+            old_scaler = _state.autoscalers.pop(prefix, None)
             _state.routes[prefix] = handle
+            _state.admission[prefix] = AdmissionController(
+                handle, admission_policy)
+            if scaler is not None:
+                _state.autoscalers[prefix] = scaler.start()
     except Exception:  # noqa: BLE001 — ANY failure past replica start must release them
         _retire(handle)  # deployment failed after replicas started
         raise
+    if old_scaler is not None:
+        old_scaler.stop()  # must not keep scaling the retired handle
     if old is not None:
         # Redeploy on an existing route: retire the previous deployment's
         # replicas so their actor processes and chip leases are released.
@@ -212,8 +314,11 @@ def _retire(handle: DeploymentHandle) -> None:
 
     handle.stop()
     with handle._lock:
-        replicas = list(handle._replicas)
+        # draining replicas (mid-rollout/scale-down) hold processes and
+        # leases too — a retire must not leak them
+        replicas = list(handle._replicas) + list(handle._draining)
         handle._replicas = []
+        handle._draining = []
     for replica in replicas:
         try:
             kill(replica)
@@ -221,9 +326,24 @@ def _retire(handle: DeploymentHandle) -> None:
             pass
 
 
-def shutdown() -> None:
-    """Stop the proxy and kill every replica actor."""
+def rollout(route_prefix: str = "/", timeout: float = 120.0) -> int:
+    """Zero-downtime redeploy of one route's replicas: each is swapped for
+    a freshly spawned replica, draining the old one first so in-flight
+    streams finish where they started.  Returns the number swapped."""
     with _state.lock:
+        handle = _state.routes.get(route_prefix)
+    if handle is None:
+        raise KeyError(f"no deployment at route {route_prefix!r}")
+    return handle.rollout(timeout=timeout)
+
+
+def shutdown() -> None:
+    """Stop the proxy, the control loops, and every replica actor."""
+    with _state.lock:
+        for scaler in _state.autoscalers.values():
+            scaler.stop()
+        _state.autoscalers.clear()
+        _state.admission.clear()
         for handle in _state.routes.values():
             _retire(handle)
         _state.routes.clear()
@@ -248,6 +368,23 @@ def replica_engine_stats() -> Dict[str, Dict[str, Any]]:
         except Exception:  # noqa: BLE001 — scrape is best-effort
             continue
     return out
+
+
+def serve_control_stats() -> Dict[str, Any]:
+    """Per-route serve-plane control state (the ``/-/stats`` payload):
+    admission outcomes + gauges, autoscaler decisions.  The dashboard folds
+    this into ``/api/serve`` + ``/metrics``."""
+    with _state.lock:
+        controllers = dict(_state.admission)
+        scalers = dict(_state.autoscalers)
+    return {
+        prefix: {
+            "admission": controller.stats(),
+            "autoscaler": (scalers[prefix].stats()
+                           if prefix in scalers else None),
+        }
+        for prefix, controller in controllers.items()
+    }
 
 
 def status() -> Dict[str, Any]:
