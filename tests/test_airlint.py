@@ -487,6 +487,304 @@ class TestRT004NonStaticStaticArg:
         assert_quiet(self.CLEAN, "RT004")
 
 
+class TestCC001UnguardedSharedField:
+    # the write happens in _bump, reached only THROUGH the thread target
+    # _loop — a per-function analyzer sees no thread anywhere near it
+    VIOLATION = """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._n = 0
+                self._thread = threading.Thread(target=self._loop)
+                self._thread.start()
+
+            def _loop(self):
+                self._bump()
+
+            def _bump(self):
+                self._n = self._n + 1
+
+            def read(self):
+                return self._n
+        """
+
+    CLEAN = """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._n = 0
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=self._loop)
+                self._thread.start()
+
+            def _loop(self):
+                with self._lock:
+                    self._n = self._n + 1
+
+            def read(self):
+                with self._lock:
+                    return self._n
+        """
+
+    def test_fires_interprocedural_race(self):
+        f = assert_fires(self.VIOLATION, "CC001", "self._n = self._n + 1")
+        assert f.severity == Severity.ERROR
+        assert "Counter._n" in f.message
+        # the dataflow block carries the cross-function witness: the write
+        # is only reachable via the thread target
+        paths = [a["call_path"] for a in f.dataflow["accesses"]]
+        assert ["Counter._loop", "Counter._bump"] in paths
+
+    def test_clean_twin(self):
+        assert_quiet(self.CLEAN, "CC001")
+
+    def test_lockset_inconsistency_fires(self):
+        # no thread spawn in sight: holding the lock on ONE side is itself
+        # the evidence the field is meant to be shared
+        src = """\
+            import threading
+
+            class Gauge:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._v = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._v += 1
+
+                def read(self):
+                    return self._v
+            """
+        f = assert_fires(src, "CC001", "return self._v")
+        assert "Gauge._v" in f.message
+
+    def test_init_only_field_not_flagged(self):
+        src = """\
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self.cap = 16
+                    self._thread = threading.Thread(target=self._loop)
+                    self._thread.start()
+
+                def _loop(self):
+                    return self.cap
+
+                def read(self):
+                    return self.cap
+            """
+        assert_quiet(src, "CC001")
+
+
+class TestCC002LockOrderInversion:
+    # ab() takes _a then reaches _b only through _grab_b(): each function
+    # in isolation has a consistent local order — the inversion exists
+    # only in the call graph, which is exactly what the old per-function
+    # analyzer provably could not flag
+    VIOLATION = """\
+        import threading
+
+        class Transfer:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    self._grab_b()
+
+            def _grab_b(self):
+                with self._b:
+                    pass
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """
+
+    CLEAN = """\
+        import threading
+
+        class Transfer:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    self._grab_b()
+
+            def _grab_b(self):
+                with self._b:
+                    pass
+
+            def ba(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """
+
+    def test_fires_across_methods(self):
+        f = assert_fires(self.VIOLATION, "CC002", "self._grab_b()")
+        assert f.severity == Severity.ERROR
+        assert "Transfer._a" in f.message and "Transfer._b" in f.message
+        assert set(f.dataflow["locks"]) == {"Transfer._a", "Transfer._b"}
+
+    def test_clean_twin(self):
+        # same shape, both paths agree on a-before-b: one global order
+        assert_quiet(self.CLEAN, "CC002")
+
+    def test_local_nesting_fires(self):
+        src = """\
+            import threading
+
+            class Transfer:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def ba(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """
+        assert_fires(src, "CC002", "with self._b:")
+
+    def test_reported_once_per_pair(self):
+        rep = check(self.VIOLATION, only=["CC002"])
+        assert len([f for f in rep.active if f.rule == "CC002"]) == 1
+
+
+class TestCC003BlockingUnderLock:
+    # the sleep is two calls away from the critical section: refresh()
+    # holds the lock, _rebuild() blocks — only the call graph connects them
+    VIOLATION = """\
+        import threading
+        import time
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                with self._lock:
+                    self._rebuild()
+
+            def _rebuild(self):
+                time.sleep(1.0)
+        """
+
+    CLEAN = """\
+        import threading
+        import time
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                with self._lock:
+                    n = 1
+                time.sleep(1.0)
+                return n
+        """
+
+    def test_fires_interprocedurally(self):
+        f = assert_fires(self.VIOLATION, "CC003", "self._rebuild()")
+        assert f.severity == Severity.WARNING
+        assert "time.sleep" in f.message and "Pool._lock" in f.message
+        assert f.dataflow["lockset"] == ["Pool._lock"]
+
+    def test_clean_twin(self):
+        # same sleep, outside the critical section
+        assert_quiet(self.CLEAN, "CC003")
+
+    def test_typed_event_wait_fires(self):
+        src = """\
+            import threading
+
+            class Gate:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._ready = threading.Event()
+
+                def wait_ready(self):
+                    with self._lock:
+                        self._ready.wait()
+            """
+        assert_fires(src, "CC003", "self._ready.wait()")
+
+
+class TestJX006JitBoundaryEscape:
+    # helper() launders the jitted output through one call-graph hop; the
+    # mutation site itself never mentions jit
+    VIOLATION = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def helper(x):
+            return step(x)
+
+        def run(x):
+            out = helper(x)
+            out[0] = 1.0
+            return out
+        """
+
+    CLEAN = """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def run(x):
+            out = np.asarray(step(x)).copy()
+            out[0] = 1.0
+            return out
+        """
+
+    def test_fires_through_call_graph(self):
+        f = assert_fires(self.VIOLATION, "JX006", "out[0] = 1.0")
+        assert f.severity == Severity.WARNING
+        assert "immutable" in f.message
+        assert "step" in " ".join(f.dataflow["call_path"])
+
+    def test_clean_twin(self):
+        # copied to numpy before mutating: host-side mutation is fine
+        assert_quiet(self.CLEAN, "JX006")
+
+    def test_rebind_untaints(self):
+        src = """\
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * 2
+
+            def run(x):
+                out = step(x)
+                out = [0.0]
+                out[0] = 1.0
+                return out
+            """
+        assert_quiet(src, "JX006")
+
+
 class TestAL000ParseError:
     def test_syntax_error_is_a_finding(self):
         rep = analyze_source("def broken(:\n    pass\n", path="bad.py")
@@ -496,9 +794,93 @@ class TestAL000ParseError:
 
 def test_every_rule_has_a_fixture():
     """Adding a rule without a fires+quiet fixture pair must fail CI."""
-    covered = {"JX001", "JX002", "JX003", "JX004", "JX005",
-               "RT001", "RT002", "RT003", "RT004"}
+    covered = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
+               "RT001", "RT002", "RT003", "RT004",
+               "CC001", "CC002", "CC003"}
     assert {r.id for r in all_rules()} == covered
+
+
+# ---------------------------------------------------------------------------
+# call graph (the dataflow substrate CC/JX006 stand on)
+# ---------------------------------------------------------------------------
+
+
+def _callgraph(src, path="mod.py"):
+    from tpu_air.analysis.context import ModuleContext
+    from tpu_air.analysis.dataflow.callgraph import CallGraph
+
+    return CallGraph([ModuleContext(path, textwrap.dedent(src))])
+
+
+def _fn(cg, name):
+    return next(f for f in cg.functions if f.name == name)
+
+
+class TestCallGraph:
+    def test_self_method_resolution(self):
+        cg = _callgraph("""\
+            class A:
+                def top(self):
+                    return self.helper()
+
+                def helper(self):
+                    return 1
+            """)
+        (site,) = cg.call_sites(_fn(cg, "top"))
+        assert site.callee is not None
+        assert site.callee.name == "helper"
+        assert site.callee.cls is not None and site.callee.cls.name == "A"
+
+    def test_base_class_method_resolution(self):
+        cg = _callgraph("""\
+            class Base:
+                def helper(self):
+                    return 1
+
+            class A(Base):
+                def top(self):
+                    return self.helper()
+            """)
+        (site,) = cg.call_sites(_fn(cg, "top"))
+        assert site.callee is not None and site.callee.name == "helper"
+
+    def test_shadowed_name_is_unknown_callee(self):
+        # a local rebind hides the module-level def: resolving to it
+        # anyway would fabricate call paths
+        cg = _callgraph("""\
+            def sleep():
+                return 1
+
+            def run():
+                sleep = None
+                return sleep()
+            """)
+        (site,) = cg.call_sites(_fn(cg, "run"))
+        assert site.callee is None
+
+    def test_unshadowed_module_call_resolves(self):
+        cg = _callgraph("""\
+            def sleep():
+                return 1
+
+            def run():
+                return sleep()
+            """)
+        (site,) = cg.call_sites(_fn(cg, "run"))
+        assert site.callee is not None and site.callee.name == "sleep"
+
+    def test_dynamic_call_falls_back_to_unknown(self):
+        # getattr dispatch and callable-valued locals must degrade to
+        # "unknown callee" without crashing the builder
+        cg = _callgraph("""\
+            class A:
+                def dispatch(self, name, fns):
+                    getattr(self, name)()
+                    fn = fns[0]
+                    return fn()
+            """)
+        sites = cg.call_sites(_fn(cg, "dispatch"))
+        assert sites and all(s.callee is None for s in sites)
 
 
 # ---------------------------------------------------------------------------
@@ -569,6 +951,48 @@ class TestSuppressions:
         assert [f.rule for f in rep.active] == ["JX004"]
         assert rep.active[0].line == line_of(src, "extra = float(loss)")
 
+    def test_decorated_def_span_is_covered(self):
+        """Regression: a suppression above a decorated def used to cover
+        only the first decorator line — findings anchored on a later
+        decorator (or the def line) escaped it.  The whole decorated
+        statement is one span now."""
+        src = """\
+            import jax
+
+            # airlint: disable=JX005 — fixture: span covers both decorators
+            @staticmethod
+            @validate(jax.lax.axis_index("data"))
+            def f(x):
+                return x
+            """
+        rep = check(src)
+        assert not rep.active
+        assert [f.rule for f in rep.suppressed] == ["JX005"]
+        # the finding really is on the SECOND decorator line, past the
+        # comment's own next-code-line reach
+        bare = check("""\
+            import jax
+
+            @staticmethod
+            @validate(jax.lax.axis_index("data"))
+            def f(x):
+                return x
+            """)
+        assert [f.rule for f in bare.active] == ["JX005"]
+        assert bare.active[0].line == 4  # the second decorator line
+
+    def test_decorated_spans_table(self):
+        from tpu_air.analysis.context import ModuleContext
+
+        src = textwrap.dedent("""\
+            @deco
+            @other
+            def f():
+                pass
+            """)
+        ctx = ModuleContext("m.py", src)
+        assert ctx.decorated_spans() == [(1, 3)]
+
     def test_meta_findings_are_never_suppressible(self):
         src = """\
             # airlint: disable-file=AL001 — trying to silence the meta rule
@@ -594,6 +1018,19 @@ def test_self_application_zero_unsuppressed():
     reports = analyze_paths([str(REPO / "tpu_air")])
     active = [f for rep in reports for f in rep.active]
     assert not active, "unsuppressed airlint findings:\n" + "\n".join(
+        f"  {f.location()}: {f.rule}: {f.message}" for f in active)
+    for f in (f for rep in reports for f in rep.suppressed):
+        assert f.suppress_reason, f"reason-less suppression at {f.location()}"
+
+
+def test_new_rules_self_application_zero_unsuppressed():
+    """The acceptance gate for this change: the concurrency + jit-escape
+    rules over the repo's own tree report nothing unsuppressed, and every
+    surviving suppression states its reason."""
+    reports = analyze_paths([str(REPO / "tpu_air")],
+                            only=["CC001", "CC002", "CC003", "JX006"])
+    active = [f for rep in reports for f in rep.active]
+    assert not active, "unsuppressed dataflow findings:\n" + "\n".join(
         f"  {f.location()}: {f.rule}: {f.message}" for f in active)
     for f in (f for rep in reports for f in rep.suppressed):
         assert f.suppress_reason, f"reason-less suppression at {f.location()}"
@@ -638,7 +1075,7 @@ class TestCLI:
         p.write_text(textwrap.dedent(TestRT002MutateAfterPut.VIOLATION))
         assert cli_main([str(p), "--json"]) == 1
         doc = json.loads(capsys.readouterr().out)
-        assert doc["version"] == 1
+        assert doc["version"] == 2
         assert doc["files_analyzed"] == 1
         (finding,) = doc["findings"]
         assert finding["rule"] == "RT002"
@@ -646,11 +1083,116 @@ class TestCLI:
         assert {"path", "line", "col", "message"} <= set(finding)
         assert doc["suppressed"] == []
 
+    def test_json_dataflow_block(self, tmp_path, capsys):
+        """Schema v2: dataflow rules attach their lockset + call-path
+        witness to the finding."""
+        p = tmp_path / "race.py"
+        p.write_text(textwrap.dedent(
+            TestCC001UnguardedSharedField.VIOLATION))
+        assert cli_main([str(p), "--json", "--rules", "CC001"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        (finding,) = doc["findings"]
+        df = finding["dataflow"]
+        assert df["class"] == "Counter" and df["field"] == "_n"
+        for acc in df["accesses"]:
+            assert {"kind", "location", "lockset", "call_path"} <= set(acc)
+
+    def test_sarif_output(self, tmp_path, capsys):
+        p = tmp_path / "bad.py"
+        p.write_text(textwrap.dedent(TestRT002MutateAfterPut.VIOLATION))
+        assert cli_main([str(p), "--format", "sarif"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        driver = doc["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "airlint"
+        assert [r["id"] for r in driver["rules"]] == ["RT002"]
+        (res,) = doc["runs"][0]["results"]
+        assert res["ruleId"] == "RT002" and res["level"] == "error"
+        region = res["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] == 3 and region["startColumn"] >= 1
+
+    def test_sarif_carries_dataflow_properties(self, tmp_path, capsys):
+        p = tmp_path / "race.py"
+        p.write_text(textwrap.dedent(
+            TestCC001UnguardedSharedField.VIOLATION))
+        assert cli_main([str(p), "--format", "sarif",
+                         "--rules", "CC001"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        (res,) = doc["runs"][0]["results"]
+        assert res["properties"]["dataflow"]["field"] == "_n"
+
     def test_list_rules(self, capsys):
         assert cli_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        for rid in ("JX001", "JX004", "RT001", "RT004"):
+        for rid in ("JX001", "JX004", "RT001", "RT004",
+                    "CC001", "CC002", "CC003", "JX006"):
             assert rid in out
+
+    def test_changed_scopes_to_changed_files(self, tmp_path):
+        """--changed lints the diff vs the merge-base with main (plus
+        dependents) — the committed baseline's findings stay out."""
+        def git(*a):
+            subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                           capture_output=True, timeout=60)
+
+        git("init")
+        git("config", "user.email", "lint@example.com")
+        git("config", "user.name", "lint")
+        (tmp_path / "committed.py").write_text(
+            textwrap.dedent(TestJX004HostSyncInHotPath.VIOLATION))
+        git("add", ".")
+        git("commit", "-m", "seed")
+        git("branch", "-M", "main")
+        git("checkout", "-b", "feature")
+        (tmp_path / "fresh.py").write_text(
+            textwrap.dedent(TestRT002MutateAfterPut.VIOLATION))
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "airlint.py"),
+             "--changed", "--json", "."],
+            capture_output=True, text=True, cwd=tmp_path, timeout=60)
+        assert proc.returncode == 1, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert {f["rule"] for f in doc["findings"]} == {"RT002"}
+        assert all(f["path"].endswith("fresh.py") for f in doc["findings"])
+
+    def test_changed_pulls_in_call_graph_dependents(self, tmp_path):
+        """A caller of a changed module is re-linted even though its own
+        file did not change."""
+        def git(*a):
+            subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                           capture_output=True, timeout=60)
+
+        git("init")
+        git("config", "user.email", "lint@example.com")
+        git("config", "user.name", "lint")
+        (tmp_path / "caller.py").write_text(textwrap.dedent("""\
+            import helper
+
+            def train_loop(batches):
+                total = 0.0
+                for batch in batches:
+                    loss = helper.step(batch)
+                    total += float(loss)
+                return total
+            """))
+        (tmp_path / "helper.py").write_text(
+            "def step(batch):\n    return batch\n")
+        git("add", ".")
+        git("commit", "-m", "seed")
+        git("branch", "-M", "main")
+        git("checkout", "-b", "feature")
+        # touch ONLY helper.py; caller.py's JX004 must still be reported
+        (tmp_path / "helper.py").write_text(
+            "def step(batch):\n    return batch * 2\n")
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "airlint.py"),
+             "--changed", "--json", "."],
+            capture_output=True, text=True, cwd=tmp_path, timeout=60)
+        assert proc.returncode == 1, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert {f["rule"] for f in doc["findings"]} == {"JX004"}
+        assert all(f["path"].endswith("caller.py")
+                   for f in doc["findings"])
 
     def test_tools_launcher_json_gate(self, tmp_path):
         """tools/airlint.py --json must exit nonzero on findings — this is

@@ -1,0 +1,33 @@
+"""The analyzer must hold itself to its own bar.
+
+``tpu_air/analysis/`` is linted with EVERY rule enabled and must come back
+with zero findings — not even suppressed ones.  The analysis package is
+the one place where "suppress with a reason" is not an acceptable answer:
+if a rule misfires on the analyzer itself, the rule (or the code) gets
+fixed, so the package stays a living demonstration that the rule set is
+satisfiable without escape hatches.
+"""
+
+from pathlib import Path
+
+from tpu_air.analysis import analyze_paths
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_analysis_package_is_clean_under_all_rules():
+    reports = analyze_paths([str(REPO / "tpu_air" / "analysis")])
+    findings = [f for rep in reports for f in rep.findings]
+    assert not findings, "airlint findings in tpu_air/analysis/:\n" + "\n".join(
+        f"  {f.location()}: {f.rule}: {f.message}"
+        f"{' [suppressed]' if f.suppressed else ''}" for f in findings)
+
+
+def test_analysis_package_is_clean_under_dataflow_rules_alone():
+    """The dataflow rules see a different (program-wide) view when run in
+    isolation — both views must agree that the package is clean."""
+    reports = analyze_paths([str(REPO / "tpu_air" / "analysis")],
+                            only=["CC001", "CC002", "CC003", "JX006"])
+    findings = [f for rep in reports for f in rep.findings]
+    assert not findings, "\n".join(
+        f"  {f.location()}: {f.rule}: {f.message}" for f in findings)

@@ -33,20 +33,24 @@ from .registry import (  # noqa: F401 — re-export
 from . import rules_jax as _rules_jax  # noqa: E402,F401
 from . import rules_runtime as _rules_runtime  # noqa: E402,F401
 from .context import ModuleContext
+from .dataflow import ProgramContext
+from .dataflow import rules_concurrency as _rules_cc  # noqa: E402,F401
+from .dataflow import rules_jitflow as _rules_jf  # noqa: E402,F401
 from .suppressions import apply_suppressions, parse_suppressions
 
 
-def analyze_source(source: str, path: str = "<string>",
-                   only: Optional[Iterable[str]] = None) -> FileReport:
-    """Run the (selected) rule set over one source string."""
+def _parse_error_report(path: str, e: SyntaxError) -> FileReport:
     report = FileReport(path=path)
-    try:
-        ctx = ModuleContext(path, source)
-    except SyntaxError as e:
-        report.findings.append(Finding(
-            "AL000", Severity.ERROR, path, e.lineno or 1, e.offset or 0,
-            f"file does not parse: {e.msg}"))
-        return report
+    report.findings.append(Finding(
+        "AL000", Severity.ERROR, path, e.lineno or 1, e.offset or 0,
+        f"file does not parse: {e.msg}"))
+    return report
+
+
+def _analyze_ctx(ctx: ModuleContext,
+                 only: Optional[Iterable[str]] = None) -> FileReport:
+    """Run the (selected) rule set over one already-parsed module."""
+    report = FileReport(path=ctx.path)
     findings: List[Finding] = []
     for r in select_rules(only):
         findings.extend(r.check(ctx))
@@ -55,6 +59,19 @@ def analyze_source(source: str, path: str = "<string>",
     findings.extend(idx.meta_findings)
     report.findings = sorted(findings, key=Finding.sort_key)
     return report
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   only: Optional[Iterable[str]] = None) -> FileReport:
+    """Run the (selected) rule set over one source string.  The dataflow
+    rules see a single-module program — cross-module resolution needs
+    :func:`analyze_paths`."""
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as e:
+        return _parse_error_report(path, e)
+    ctx.program = ProgramContext([ctx])
+    return _analyze_ctx(ctx, only)
 
 
 def iter_python_files(paths: Iterable[str]) -> List[str]:
@@ -72,10 +89,39 @@ def iter_python_files(paths: Iterable[str]) -> List[str]:
 
 
 def analyze_paths(paths: Iterable[str],
-                  only: Optional[Iterable[str]] = None) -> List[FileReport]:
-    reports = []
-    for fpath in iter_python_files(paths):
+                  only: Optional[Iterable[str]] = None,
+                  changed: Optional[Iterable[str]] = None
+                  ) -> List[FileReport]:
+    """Analyze every python file under ``paths`` with one shared
+    :class:`ProgramContext` (so the dataflow rules resolve calls across
+    modules).  With ``changed`` (an iterable of file paths), the whole
+    tree still feeds the program context, but only changed files plus
+    their call-graph dependents are rule-checked and reported — the
+    ``--changed`` incremental mode."""
+    files = iter_python_files(paths)
+    parse_errors = {}
+    contexts: List[ModuleContext] = []
+    for fpath in files:
         with open(fpath, "r", encoding="utf-8") as f:
             source = f.read()
-        reports.append(analyze_source(source, path=fpath, only=only))
+        try:
+            contexts.append(ModuleContext(fpath, source))
+        except SyntaxError as e:
+            parse_errors[fpath] = _parse_error_report(fpath, e)
+    program = ProgramContext(contexts)
+    scope = None
+    if changed is not None:
+        scope = program.dependent_closure(changed)
+    reports = []
+    for fpath in files:
+        in_scope = scope is None or os.path.normpath(fpath) in scope
+        if fpath in parse_errors:
+            if in_scope:
+                reports.append(parse_errors[fpath])
+            continue
+        if not in_scope:
+            continue
+        ctx = program.module(fpath)
+        ctx.program = program
+        reports.append(_analyze_ctx(ctx, only=only))
     return reports

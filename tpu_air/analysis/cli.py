@@ -1,21 +1,29 @@
 """airlint CLI.
 
-Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error.  ``--json``
-emits the schema documented in docs/ANALYSIS.md (stable: version bumps on
-breaking change) so CI and tooling can gate on it.
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error.  ``--format
+json`` (alias ``--json``) emits schema v2 documented in docs/ANALYSIS.md
+(stable: version bumps on breaking change); ``--format sarif`` emits SARIF
+2.1.0 for CI annotation.  ``--changed`` lints only the files changed vs
+``git merge-base HEAD main`` plus their call-graph dependents — the whole
+tree still feeds call resolution, so interprocedural findings stay exact.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from . import analyze_paths, all_rules
 from .findings import Severity
 
-JSON_SCHEMA_VERSION = 1
+JSON_SCHEMA_VERSION = 2
+SARIF_VERSION = "2.1.0"
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -25,8 +33,17 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("paths", nargs="*", default=["tpu_air"],
                    help="files or directories to analyze (default: tpu_air)")
-    p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit machine-readable JSON on stdout")
+    p.add_argument("--format", choices=("human", "json", "sarif"),
+                   default="human", dest="fmt",
+                   help="output format (default: human)")
+    p.add_argument("--json", action="store_const", const="json", dest="fmt",
+                   help="shorthand for --format json")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only files changed vs the merge-base with "
+                        "main (plus their call-graph dependents)")
+    p.add_argument("--changed-base", default=None, metavar="REF",
+                   help="diff base for --changed (default: "
+                        "`git merge-base HEAD main`)")
     p.add_argument("--rules", default=None, metavar="ID[,ID...]",
                    help="run only these rule ids")
     p.add_argument("--list-rules", action="store_true",
@@ -40,6 +57,30 @@ def _list_rules() -> None:
     for r in sorted(all_rules(), key=lambda r: r.id):
         print(f"{r.id}  {r.severity:<7}  {r.name}")
         print(f"       {r.rationale}")
+
+
+def _git(args: List[str]) -> Optional[str]:
+    try:
+        out = subprocess.run(["git"] + args, capture_output=True,
+                             text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout if out.returncode == 0 else None
+
+
+def changed_files(base: Optional[str] = None) -> Optional[Set[str]]:
+    """Python files changed vs ``base`` (default: merge-base with main),
+    plus untracked ones.  None when git is unusable here."""
+    if base is None:
+        mb = _git(["merge-base", "HEAD", "main"])
+        base = mb.strip() if mb else "HEAD"
+    diff = _git(["diff", "--name-only", base])
+    if diff is None:
+        return None
+    untracked = _git(["ls-files", "--others", "--exclude-standard"]) or ""
+    return {os.path.normpath(p)
+            for p in (diff.splitlines() + untracked.splitlines())
+            if p.endswith(".py")}
 
 
 def _human(reports, show_suppressed: bool) -> None:
@@ -61,27 +102,86 @@ def _json_out(reports) -> None:
     }, indent=2))
 
 
+def _sarif_out(reports) -> None:
+    from .registry import META_RULES, get_rule
+
+    ids = sorted({f.rule for rep in reports for f in rep.active})
+    rules = []
+    for rid in ids:
+        r = get_rule(rid) if rid not in META_RULES else META_RULES[rid]
+        rules.append({
+            "id": r.id,
+            "name": r.name,
+            "shortDescription": {"text": r.name},
+            "fullDescription": {"text": r.rationale},
+            "defaultConfiguration": {
+                "level": "error" if r.severity == Severity.ERROR
+                else "warning"},
+        })
+    results = []
+    for rep in reports:
+        for f in rep.active:
+            result = {
+                "ruleId": f.rule,
+                "level": "error" if f.severity == Severity.ERROR
+                else "warning",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/")},
+                        "region": {"startLine": f.line,
+                                   "startColumn": max(f.col, 0) + 1},
+                    }
+                }],
+            }
+            if f.dataflow:
+                result["properties"] = {"dataflow": f.dataflow}
+            results.append(result)
+    print(json.dumps({
+        "$schema": _SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "airlint",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }, indent=2))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.list_rules:
         _list_rules()
         return 0
     only = args.rules.split(",") if args.rules else None
+    changed = None
+    if args.changed:
+        changed = changed_files(args.changed_base)
+        if changed is None:
+            print("airlint: --changed needs a git checkout "
+                  "(git diff failed); analyzing everything",
+                  file=sys.stderr)
     try:
-        reports = analyze_paths(args.paths, only=only)
+        reports = analyze_paths(args.paths, only=only, changed=changed)
     except KeyError as e:
         print(f"airlint: {e.args[0]}", file=sys.stderr)
         return 2
     except OSError as e:
         print(f"airlint: {e}", file=sys.stderr)
         return 2
-    if args.as_json:
+    if args.fmt == "json":
         _json_out(reports)
+    elif args.fmt == "sarif":
+        _sarif_out(reports)
     else:
         _human(reports, args.show_suppressed)
     active = [f for rep in reports for f in rep.active]
     n_sup = sum(len(rep.suppressed) for rep in reports)
-    if not args.as_json:
+    if args.fmt == "human":
         errors = sum(f.severity == Severity.ERROR for f in active)
         warnings = len(active) - errors
         print(f"airlint: {len(reports)} file(s), {errors} error(s), "

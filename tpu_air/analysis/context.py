@@ -101,6 +101,10 @@ class ModuleContext:
         self.comments = self._comment_map(source)
         self._jitted_functions = None
         self._jit_wrapped_names = None
+        self._decorated_spans = None
+        # the ProgramContext of the analysis run (set by analyze_paths /
+        # analyze_source); dataflow rules consult it for cross-module state
+        self.program = None
 
     # -- structure -----------------------------------------------------------
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
@@ -178,6 +182,26 @@ class ModuleContext:
                     table[node.targets[0].id] = info
         self._jit_wrapped_names = table
         return table
+
+    # -- statement spans -----------------------------------------------------
+    def decorated_spans(self) -> List[Tuple[int, int]]:
+        """Inclusive line spans (first decorator line → last header line)
+        of every decorated def/class.  A suppression anywhere in the span
+        covers findings reported anywhere in it — rules report on the
+        decorator OR the ``def`` line, and a comment above the statement
+        must attach to both."""
+        if self._decorated_spans is None:
+            spans = []
+            for node in self.nodes:
+                if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef))
+                        and node.decorator_list):
+                    start = min(d.lineno for d in node.decorator_list)
+                    body_start = node.body[0].lineno if node.body \
+                        else node.lineno
+                    spans.append((start, max(node.lineno, body_start - 1)))
+            self._decorated_spans = spans
+        return self._decorated_spans
 
     # -- comments ------------------------------------------------------------
     @staticmethod

@@ -1,0 +1,171 @@
+"""JX006 — jit-boundary escape: a device array returned from a jitted
+region, then mutated host-side.
+
+``jax.jit`` returns immutable device arrays: ``out[0] = x`` raises at
+runtime (or, worse, silently mutates a stale numpy copy when someone
+wrapped the result). The hazard is invisible per-function when the jitted
+call is hidden behind a helper, so this analysis is call-graph-tracked:
+
+- a function *returns jit output* when some ``return`` returns the result
+  of a module-visible jit-wrapped callable, or (transitively) of a
+  resolved callee that returns jit output;
+- inside every analyzed function, names bound to such calls are tainted,
+  and an in-place mutation of a tainted name (subscript store, augmented
+  subscript store, in-place mutator method) is reported;
+- rebinding untaints; so does an explicit host conversion
+  (``np.asarray``/``np.array``/``jax.device_get``/``.copy()``), which is
+  also the documented fix.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..context import dotted
+from .callgraph import CallGraph, FunctionInfo, walk_scope
+from .lockset import RawFinding, _display
+
+_HOST_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                    "jax.device_get", "onp.asarray", "onp.array"}
+_NP_MUTATORS = {"sort", "fill", "resize", "put", "itemset", "setflags",
+                "partition", "byteswap"}
+
+
+class JitFlowAnalysis:
+    """Computes JX006 findings for every module in one call graph."""
+
+    def __init__(self, cg: CallGraph):
+        self.cg = cg
+        self._returns_jit: Dict[str, bool] = {}
+        self.findings: List[RawFinding] = []
+        self._ran = False
+
+    def run(self) -> List[RawFinding]:
+        if self._ran:
+            return self.findings
+        self._ran = True
+        for fn in self.cg.functions:
+            self._scan_function(fn)
+        return self.findings
+
+    # -- transitive "returns jit output" summary ----------------------------
+    def _jit_origin(self, fn: FunctionInfo,
+                    call: ast.Call) -> Optional[List[str]]:
+        """If ``call`` (in ``fn``) yields jit output, the witness chain:
+        ``[jitted_name]`` for a direct jitted call, else
+        ``[callee, ..., jitted_name]`` through resolved callees."""
+        name = dotted(call.func)
+        if name is not None and name in fn.ctx.jit_wrapped_names():
+            return [name]
+        site = self.cg.resolve_call(fn, call)
+        if site.callee is not None and self.returns_jit(site.callee):
+            return [_display(site.callee)] + self._return_chain(site.callee)
+        return None
+
+    def returns_jit(self, fn: FunctionInfo,
+                    _stack: Tuple[str, ...] = ()) -> bool:
+        if fn.qname in self._returns_jit:
+            return self._returns_jit[fn.qname]
+        if fn.qname in _stack or len(_stack) > 6:
+            return False
+        stack = _stack + (fn.qname,)
+        result = False
+        for node in walk_scope(fn.node):
+            if not (isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            name = dotted(node.value.func)
+            if name is not None and name in fn.ctx.jit_wrapped_names():
+                result = True
+                break
+            site = self.cg.resolve_call(fn, node.value)
+            if site.callee is not None and self.returns_jit(site.callee,
+                                                            stack):
+                result = True
+                break
+        self._returns_jit[fn.qname] = result
+        return result
+
+    def _return_chain(self, fn: FunctionInfo, depth: int = 0) -> List[str]:
+        """Short witness of where ``fn``'s jit output actually comes from."""
+        if depth > 4:
+            return []
+        for node in walk_scope(fn.node):
+            if not (isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            name = dotted(node.value.func)
+            if name is not None and name in fn.ctx.jit_wrapped_names():
+                return [name]
+            site = self.cg.resolve_call(fn, node.value)
+            if site.callee is not None and self.returns_jit(site.callee):
+                return [_display(site.callee)] + self._return_chain(
+                    site.callee, depth + 1)
+        return []
+
+    # -- per-function taint scan --------------------------------------------
+    def _scan_function(self, fn: FunctionInfo) -> None:
+        stmts = sorted(
+            (n for n in walk_scope(fn.node)
+             if isinstance(n, (ast.Assign, ast.AugAssign, ast.Expr))),
+            key=lambda n: (n.lineno, n.col_offset))
+        tainted: Dict[str, Tuple[ast.AST, List[str]]] = {}
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if isinstance(tgt, ast.Name):
+                    self._rebind(fn, tainted, tgt.id, stmt.value)
+                    continue
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in tainted):
+                    self._fire(fn, stmt, tgt.value.id, tainted[tgt.value.id])
+                continue
+            if isinstance(stmt, ast.AugAssign):
+                tgt = stmt.target
+                if isinstance(tgt, ast.Name) and tgt.id in tainted:
+                    del tainted[tgt.id]  # x += 1 rebinds to a fresh array
+                elif (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in tainted):
+                    self._fire(fn, stmt, tgt.value.id, tainted[tgt.value.id])
+                continue
+            if (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Attribute)
+                    and isinstance(stmt.value.func.value, ast.Name)):
+                name = stmt.value.func.value.id
+                if (name in tainted
+                        and stmt.value.func.attr in _NP_MUTATORS):
+                    self._fire(fn, stmt, name, tainted[name])
+
+    def _rebind(self, fn: FunctionInfo, tainted, name: str,
+                value: ast.AST) -> None:
+        if isinstance(value, ast.Call):
+            chain = self._jit_origin(fn, value)
+            if chain is not None:
+                tainted[name] = (value, chain)
+                return
+            callee = dotted(value.func)
+            if callee in _HOST_CONVERTERS or (
+                    callee is not None and callee.endswith(".copy")):
+                tainted.pop(name, None)
+                return
+        elif isinstance(value, ast.Name) and value.id in tainted:
+            tainted[name] = tainted[value.id]  # alias keeps the taint
+            return
+        tainted.pop(name, None)
+
+    def _fire(self, fn: FunctionInfo, node: ast.AST, name: str,
+              origin: Tuple[ast.AST, List[str]]) -> None:
+        origin_node, chain = origin
+        via = f" (origin: {' -> '.join(chain)} at line {origin_node.lineno})"
+        self.findings.append(RawFinding(
+            "JX006", fn.ctx.path, node,
+            f"`{name}` holds the output of a jitted call{via} and is "
+            "mutated host-side — jax arrays are immutable; use "
+            f"`{name}.at[...].set(...)` inside jit, or copy to numpy "
+            "(`np.asarray(x).copy()`) before mutating",
+            {"origin_line": origin_node.lineno,
+             "call_path": [_display(fn)] + chain}))

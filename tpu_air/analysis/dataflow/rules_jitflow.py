@@ -1,0 +1,18 @@
+"""JX006 — jit-boundary escape, surfaced from the program-wide
+:class:`~tpu_air.analysis.dataflow.jitflow.JitFlowAnalysis`."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..findings import Finding, Severity
+from ..registry import rule
+from . import ensure_program
+
+
+@rule("JX006", "jit-boundary-escape", Severity.WARNING,
+      "jit outputs are immutable device arrays; host-side in-place "
+      "mutation raises at runtime — or silently edits a stale copy when "
+      "the array was wrapped first")
+def jx006_jit_boundary_escape(ctx) -> List[Finding]:
+    return ensure_program(ctx).findings_for(ctx.path, "JX006")

@@ -28,6 +28,9 @@ class Finding:
     message: str
     suppressed: bool = False
     suppress_reason: str = ""
+    # schema v2: dataflow rules attach their evidence (lockset held at the
+    # access, the call-path witness) so CI annotations can show the trace
+    dataflow: dict = None
 
     def location(self) -> str:
         return f"{self.path}:{self.line}:{self.col}"
@@ -41,6 +44,8 @@ class Finding:
             "col": self.col,
             "message": self.message,
         }
+        if self.dataflow:
+            d["dataflow"] = self.dataflow
         if self.suppressed:
             d["suppressed"] = True
             d["suppress_reason"] = self.suppress_reason

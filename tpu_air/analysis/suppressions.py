@@ -101,6 +101,12 @@ def parse_suppressions(ctx, known_ids: Set[str]) -> SuppressionIndex:
         covered = {line}
         if ctx.comment_is_standalone(line):
             covered.add(_next_code_line(ctx, line))
+        # a decorated def/class is one statement: a suppression touching
+        # any line of its decorator+header span covers the whole span
+        # (findings land on the decorator line OR the def line)
+        for start, end in ctx.decorated_spans():
+            if any(start <= ln <= end for ln in covered):
+                covered.update(range(start, end + 1))
         sup.applies_to = covered
         for r in rules:
             for ln in covered:

@@ -232,9 +232,10 @@ class DisaggRouter:
                 "reroutes": self.reroutes,
                 "fallbacks": self.fallbacks,
             }
+            alive = list(self._alive)  # snapshot: _mark_dead runs concurrently
         worker_stats = []
         for i, w in enumerate(self._workers):
-            if not self._alive[i]:
+            if not alive[i]:
                 worker_stats.append({"name": f"{self.name}-prefill-{i}",
                                      "dead": True})
                 continue
@@ -255,8 +256,10 @@ class DisaggRouter:
         import tpu_air
 
         self.engine.close()
+        with self._lock:
+            alive = list(self._alive)  # snapshot: _mark_dead runs concurrently
         for i, w in enumerate(self._workers):
-            if self._alive[i]:
+            if alive[i]:
                 try:
                     tpu_air.kill(w)
                 except Exception:  # best-effort teardown races actor death

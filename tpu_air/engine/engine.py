@@ -166,8 +166,12 @@ class InferenceEngine:
         a ``submit_prefilled`` payload was ADMITTED at the router before the
         drain began — refusing it here would drop work the caller already
         streamed a first token for."""
+        # airlint: disable=CC001 — _closed/_draining are GIL-atomic
+        # monotonic bools (False→True once); a submit racing the flip is
+        # indistinguishable from one that arrived a moment earlier
         if self._closed:
             raise EngineClosedError("engine is shut down")
+        # airlint: disable=CC001 — same monotonic-flag discipline as _closed
         if self._draining and not admit_while_draining:
             raise EngineDrainingError(
                 f"engine {self.name!r} is draining; submit elsewhere")

@@ -61,6 +61,8 @@ class Scheduler:
         """Enqueue; raises :class:`EngineOverloadedError` when the total
         queue has reached this class's cap (``config.queue_cap``) —
         class-aware backpressure: the caller sees 503, retries."""
+        # airlint: disable=CC001 — key-set membership only: _queues' keys
+        # are fixed at __init__; the per-class deques mutate under _lock
         if request.priority not in self._queues:
             raise ValueError(
                 f"unknown priority {request.priority!r} "

@@ -209,11 +209,14 @@ class T5Engine:
             return worked
 
     def idle(self) -> bool:
-        return self.scheduler.depth() == 0 and self._window is None
+        with self._step_lock:  # _window is step-loop state (see step())
+            return self.scheduler.depth() == 0 and self._window is None
 
     # -- draining (same contract as InferenceEngine.drain) -------------------
     def drain(self) -> None:
         """Refuse new submits; queued + in-window work retires normally."""
+        # airlint: disable=CC001 — monotonic GIL-atomic bool, flips
+        # False→True once; a racing step() reads either value correctly
         self._draining = True
 
     @property
@@ -299,6 +302,8 @@ class T5Engine:
         self._thread.start()
 
     def _loop(self) -> None:
+        # airlint: disable=CC001 — GIL-atomic stop flag; close() sets it
+        # then joins this thread, so a stale read costs one extra iteration
         while not self._closed:
             if not self.step():
                 self.scheduler.wait_for_work(0.01)

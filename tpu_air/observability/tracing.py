@@ -390,8 +390,10 @@ class SpanRecorder:
 
     def drain(self) -> List[Span]:
         """Remove and return everything buffered (worker → driver ship)."""
-        if not self._buf:  # lock-free fast path: racing an append only
-            return []      # delays that span to the next drain
+        # airlint: disable=CC001 — deliberate lock-free emptiness probe:
+        # a racing record() only delays that span to the next drain
+        if not self._buf:
+            return []
         with self._lock:
             out = list(self._buf)
             self._buf.clear()

@@ -271,6 +271,8 @@ class ObjectPlane:
     def _serve(self) -> None:
         from tpu_air.core import serialization
 
+        # airlint: disable=CC001 — GIL-atomic stop flag; close() also
+        # closes the listener, so a blocked accept() exits via OSError
         while not self._stop:
             try:
                 conn = self._listener.accept()

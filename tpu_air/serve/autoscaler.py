@@ -72,6 +72,10 @@ class Autoscaler:
         self._gauge_source = gauge_source or handle.engine_stats
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # decision state below is written by the tick thread and read by
+        # stats() from arbitrary proxy threads — every access goes through
+        # _lock (the blocking scale_up/scale_down calls stay outside it)
+        self._lock = threading.Lock()
         self._idle_ticks = 0
         self._last_action_at = -1e18  # monotonic stamp of the last scale
         self.scale_ups = 0
@@ -132,29 +136,31 @@ class Autoscaler:
         decision = self.decide(snapshots, replicas)
         # the idle streak: only an unbroken run of idle ticks earns a
         # scale-down; any non-idle tick resets it
-        if decision == "down":
-            self._idle_ticks += 1
-            if self._idle_ticks < cfg.scale_down_idle_ticks:
-                decision = "hold"
-        else:
-            self._idle_ticks = 0
-        self.last_decision = decision
-        if decision == "hold":
-            return "hold"
-        now = monotonic()
-        if now - self._last_action_at < cfg.cooldown_s:
-            return "hold"
+        with self._lock:
+            if decision == "down":
+                self._idle_ticks += 1
+                if self._idle_ticks < cfg.scale_down_idle_ticks:
+                    decision = "hold"
+            else:
+                self._idle_ticks = 0
+            self.last_decision = decision
+            if decision == "hold":
+                return "hold"
+            if monotonic() - self._last_action_at < cfg.cooldown_s:
+                return "hold"
         if decision == "up":
             if self._handle.scale_up():
-                self.scale_ups += 1
-                self._last_action_at = monotonic()
+                with self._lock:
+                    self.scale_ups += 1
+                    self._last_action_at = monotonic()
                 return "up"
             return "hold"
         # down: drain + release; blocking here is fine (driver-side thread)
         if self._handle.scale_down():
-            self.scale_downs += 1
-            self._idle_ticks = 0
-            self._last_action_at = monotonic()
+            with self._lock:
+                self.scale_downs += 1
+                self._idle_ticks = 0
+                self._last_action_at = monotonic()
             return "down"
         return "hold"
 
@@ -182,12 +188,14 @@ class Autoscaler:
 
     # -- observability --------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
-        return {
-            "min_replicas": self.config.min_replicas,
-            "max_replicas": self.config.max_replicas,
-            "replicas": self._handle.num_replicas(),
-            "scale_ups": self.scale_ups,
-            "scale_downs": self.scale_downs,
-            "idle_ticks": self._idle_ticks,
-            "last_decision": self.last_decision,
-        }
+        replicas = self._handle.num_replicas()  # foreign call: outside _lock
+        with self._lock:
+            return {
+                "min_replicas": self.config.min_replicas,
+                "max_replicas": self.config.max_replicas,
+                "replicas": replicas,
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "idle_ticks": self._idle_ticks,
+                "last_decision": self.last_decision,
+            }
