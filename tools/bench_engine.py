@@ -221,6 +221,12 @@ def main() -> None:
     slab.close()
     paged_wall, paged_tokens, paged_ttft = _run_engine_trace(paged, schedule)
     post = paged.pool.stats()
+    # perf ledger over the trace window (reset_window() cleared the warm-up):
+    # analytic flops/bytes per program vs detected peak → roofline fraction,
+    # and the useful/wasted token split → goodput ratio
+    perf = paged.metrics.snapshot().get("perf", {})
+    perf_totals = perf.get("totals", {})
+    perf_goodput = perf.get("goodput", {})
 
     # -- TTFT flatness sub-run (paged, light load): the same shorts with
     # and without long prompts arriving ahead of them.  Slots stay free
@@ -353,6 +359,11 @@ def main() -> None:
                                      - pre["prefix_tokens_reused"]),
             "cow_copies": post["cow_copies"] - pre["cow_copies"],
             "pages_total": post["pages_total"],
+            "roofline_fraction": round(
+                perf_totals.get("roofline_fraction", 0.0), 6),
+            "model_flops_per_s": round(perf_totals.get("flops_per_s", 0.0), 1),
+            "goodput_ratio": round(perf_goodput.get("goodput_ratio", 0.0), 4),
+            "peak_source": (perf.get("peak") or {}).get("source"),
         },
         "speedup_paged_vs_request_per_call": round(base_wall / paged_wall, 3),
         "speedup_paged_vs_slab": round(slab_wall / paged_wall, 3),

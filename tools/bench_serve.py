@@ -250,14 +250,20 @@ def _run_phase(interactive_rps, background_rps, duration_s, prompts,
     # client-observed tail that no server ever saw.  The deployment is
     # fresh per phase, so the gauge window holds only this phase's samples.
     engine_ttft = {}
+    from tpu_air.engine.metrics import merge_snapshots
     from tpu_air.serve.proxy import replica_engine_stats
 
-    for snap in replica_engine_stats().values():
-        for klass, pr in (snap.get("priority") or {}).items():
-            d = pr.get("ttft_s") or {}
-            if d.get("count"):
-                engine_ttft[klass] = {"p50": d["p50"], "p99": d["p99"],
-                                      "count": d["count"]}
+    replica_snaps = replica_engine_stats()
+    # fleet-merged view: per-class TTFT quantiles from the MERGED histogram
+    # buckets (mergeable across replicas — not a max-of-p99s), and the perf
+    # ledger's roofline/goodput totals summed over replicas
+    fleet = merge_snapshots(replica_snaps) if replica_snaps else {}
+    for klass, pr in (fleet.get("priority") or {}).items():
+        d = pr.get("ttft_s") or {}
+        if d.get("count"):
+            engine_ttft[klass] = {"p50": d["p50"], "p99": d["p99"],
+                                  "count": d["count"]}
+    perf = fleet.get("perf") or {}
 
     by_class = {}
     for klass in ("interactive", "batch", "best_effort"):
@@ -279,6 +285,10 @@ def _run_phase(interactive_rps, background_rps, duration_s, prompts,
         "arrivals": len(clients),
         "wall_s": round(wall, 3),
         "tokens_per_s": round(total_tokens / wall, 2) if wall else 0.0,
+        "roofline_fraction": round(
+            (perf.get("totals") or {}).get("roofline_fraction", 0.0), 6),
+        "goodput_ratio": round(
+            (perf.get("goodput") or {}).get("goodput_ratio", 0.0), 4),
         "classes": by_class,
         "proxy_counters_delta": _counter_delta(_scrape_admission(), before),
     }

@@ -663,6 +663,7 @@ class Runtime:
             self.kill_actor(msg[1], no_restart=True)
 
     def _on_worker_death(self, worker: _WorkerState):
+        crashed_traces = []
         with self.lock:
             worker.alive = False
             outstanding = [
@@ -674,11 +675,14 @@ class Runtime:
                 if res:
                     self._release(res)
                 if not self.store.contains(task_id):
+                    trace_id = self.task_trace.pop(task_id, None)
+                    if trace_id:
+                        crashed_traces.append(trace_id)
                     self.store.put(
                         _ErrorSentinel(
                             f"WorkerCrashed(worker={worker.worker_id})",
                             "worker process died while executing this task",
-                            trace_id=self.task_trace.pop(task_id, None),
+                            trace_id=trace_id,
                         ),
                         task_id,
                     )
@@ -703,6 +707,23 @@ class Runtime:
             self.workers.pop(worker.worker_id, None)
         if dead_actor:
             self._gcs("mark_actor_dead", dead_actor)
+        # flight recorder (outside the lock: dump() scrapes snapshot()/
+        # engine_stats(), which re-take it); no-op unless
+        # TPU_AIR_POSTMORTEM_DIR is set, and dump() never raises
+        from tpu_air.observability import postmortem as _postmortem
+
+        if _postmortem.enabled():
+            _postmortem.dump(
+                f"WorkerCrashed(worker={worker.worker_id})",
+                {
+                    "worker_id": worker.worker_id,
+                    "pid": worker.proc.pid,
+                    "actor_id": worker.actor_id,
+                    "busy_task": worker.busy_task,
+                    "outstanding_tasks": outstanding,
+                    "trace_ids": crashed_traces,
+                },
+            )
         self._notify_objects()
         self._schedule()
 

@@ -56,6 +56,7 @@ from tpu_air.models.lm.generate import (
 )
 
 from tpu_air.observability import tracing as _tracing
+from tpu_air.observability import perf as _perf
 
 from .kvpool import PagedKVPool
 from .metrics import EngineMetrics, unregister
@@ -120,6 +121,22 @@ class InferenceEngine:
         self.scheduler = Scheduler(cfg)
         self.slots = SlotManager(cfg.num_slots)
         self.metrics = EngineMetrics(name=name, num_slots=cfg.num_slots)
+        # airscope: analytic flops/bytes per compiled program, fed into the
+        # metrics ledger with each program's measured wall time.  The
+        # decode-step cost is a CONSTANT — the fixed-shape step attends the
+        # full compiled context for every slot regardless of occupancy, so
+        # it is priced once at the compiled shape (S rows × slot_len).
+        # Geometry-gated: the decoder-only formulas only apply to configs
+        # exposing the LM geometry (T5's window engine skips the ledger).
+        mc = self.model.config
+        if all(hasattr(mc, a) for a in ("d_model", "n_layers", "n_heads",
+                                        "head_dim", "d_ff", "vocab_size")):
+            self._cost_model: Optional[Any] = _perf.LMCostModel(mc)
+            self._decode_cost = self._cost_model.decode_step_cost(
+                cfg.num_slots, cfg.slot_len)
+        else:
+            self._cost_model = None
+            self._decode_cost = None
 
         self._next_request_id = 0
         self._id_lock = threading.Lock()
@@ -376,6 +393,11 @@ class InferenceEngine:
             return
         slot.prefilling = True
         slot.plan = self.pool.admit(slot.index, req.prompt, req.max_new_tokens)
+        # chunks about to be recomputed whose content the prefix cache held
+        # before eviction: work the machine already did once (goodput waste)
+        reprefill = getattr(slot.plan, "reprefill_tokens", 0)
+        if reprefill:
+            self.metrics.record_goodput("reprefill_cache_miss", reprefill)
 
     def _admit_prefilled(self, slot: Slot, req: Request) -> None:
         """Disaggregated handoff landing (engine/dist/): allocate UNSHARED
@@ -398,7 +420,8 @@ class InferenceEngine:
             # the (remote) prefill from double-reporting as a local span
             req.t_first_ns = req.t_admit_ns
         self.metrics.record_ttft(req.first_token_at - req.submitted_at,
-                                 req.priority)
+                                 req.priority,
+                                 trace_id=(req.trace_ctx or {}).get("trace_id"))
         req.stream._emit(first)
         self.metrics.record_tokens(1)
         self.pool.register(slot.index, req.prompt)
@@ -452,10 +475,20 @@ class InferenceEngine:
         is_last = plan.chunks_done == len(plan.chunk_starts) - 1
         last_local = (n - 1 - p0) if is_last else (C - 1)
         row = self.pool.chunk_row(slot.index, p0, plan.null_target)
+        t0 = time.monotonic()
         self.cache, tok = self._chunk_fn(
             self.params, self.cache, jnp.asarray(ids), jnp.int32(p0),
             jnp.int32(last_local), jnp.asarray(row),
         )
+        if self._cost_model is not None:
+            # dispatch-time measurement: only the final chunk is host-synced
+            # (int(tok) below), so mid-prompt chunk seconds are the dispatch
+            # cost on an async backend — exact on CPU, a lower bound on TPU
+            # (on-chip rerun is ROADMAP item 5's lane)
+            self.metrics.record_program(
+                "prefill_chunk",
+                self._cost_model.prefill_chunk_cost(C, p0),
+                time.monotonic() - t0)
         plan.chunks_done += 1
         self._chunks_run += 1
         if not plan.done:
@@ -466,7 +499,8 @@ class InferenceEngine:
         if req.t_submit_ns:  # traced request: stamp TTFT for span emission
             req.t_first_ns = _tracing.now_ns()
         self.metrics.record_ttft(req.first_token_at - req.submitted_at,
-                                 req.priority)
+                                 req.priority,
+                                 trace_id=(req.trace_ctx or {}).get("trace_id"))
         req.stream._emit(first)
         self.metrics.record_tokens(1)  # prefill's first token
         self.pool.register(slot.index, req.prompt)
@@ -508,7 +542,8 @@ class InferenceEngine:
         if req.t_submit_ns:  # traced request: stamp TTFT for span emission
             req.t_first_ns = _tracing.now_ns()
         self.metrics.record_ttft(req.first_token_at - req.submitted_at,
-                                 req.priority)
+                                 req.priority,
+                                 trace_id=(req.trace_ctx or {}).get("trace_id"))
         req.stream._emit(first)
         self.metrics.record_tokens(1)  # prefill's first token
         slot.request = req
@@ -551,6 +586,8 @@ class InferenceEngine:
             )
         nxt = np.asarray(nxt)
         dt = time.monotonic() - t0
+        if self._decode_cost is not None:
+            self.metrics.record_program("decode_step", self._decode_cost, dt)
         emitted = 0
         for slot in self.slots.active_slots():
             if slot.prefilling:
@@ -576,6 +613,10 @@ class InferenceEngine:
             self._emit_request_spans(slot)
         slot.request.stream._finish()
         self.metrics.record_complete()
+        # goodput: every token this stream emitted reached a consumer that
+        # saw the stream complete — useful work
+        self.metrics.record_goodput(
+            "useful", slot.pos - len(slot.request.prompt) + 1)
         if self.paged:
             # private pages return to the free list; prompt pages the prefix
             # cache registered stay resident for future hits
@@ -660,8 +701,25 @@ class InferenceEngine:
             err = EngineClosedError("engine shut down")
             for req in self.scheduler.drain():
                 req.stream._finish(err)
+            # goodput: compute already spent on in-flight requests is lost —
+            # a drained close sheds work it had prefilled (the stream moved
+            # to another replica), a hard close kills live streams outright
+            waste_cat = ("shed_after_prefill" if self._draining
+                         else "dead_stream")
             for slot in self.slots.active_slots():
-                slot.request.stream._finish(err)
+                req = slot.request
+                if slot.prefilling:
+                    plan = slot.plan
+                    done_tokens = 0
+                    if plan is not None and plan.chunks_done:
+                        done_tokens = min(
+                            plan.chunks_done * self.config.page_len,
+                            len(req.prompt))
+                    wasted = done_tokens
+                else:
+                    wasted = slot.pos - len(req.prompt) + 1
+                self.metrics.record_goodput(waste_cat, wasted)
+                req.stream._finish(err)
                 if self.paged:
                     self.pool.release(slot.index)
                 self.slots.release(slot)

@@ -16,8 +16,13 @@ admits with only the uncovered suffix's pages.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
+
+# chunk-content hashes remembered for reprefill detection (bounded: the
+# set answers "was this page's content ever resident?", not residency)
+_SEEN_CHUNK_CAP = 4096
 
 import numpy as np
 
@@ -45,6 +50,10 @@ class AdmitPlan:
     prefix_tokens: int = 0       # tokens covered by the prefix cache
     shared_tail: bool = False    # tail page shared -> CoW before 1st append
     chunks_done: int = 0
+    # tokens in chunk_starts whose content the prefix cache HELD at some
+    # point and has since evicted: prefill work the machine repeats (the
+    # perf ledger's "reprefill_cache_miss" goodput category)
+    reprefill_tokens: int = 0
 
     @property
     def done(self) -> bool:
@@ -76,6 +85,10 @@ class PagedKVPool:
         self._cow_reserve: List[Optional[int]] = [None] * num_slots
         self._plans: List[Optional[AdmitPlan]] = [None] * num_slots
         self.cow_copies = 0
+        # LRU set of page-content hashes ever published to the prefix cache
+        # (register); admit consults it to flag re-prefilled chunks
+        self._seen_chunks: "OrderedDict[int, None]" = OrderedDict()
+        self.reprefill_tokens = 0
 
     # -- capacity ------------------------------------------------------------
     def _total_pages(self, prompt_len: int, budget: int) -> int:
@@ -164,13 +177,32 @@ class PagedKVPool:
             chunk_starts = [((n - 1) // C) * C]
         else:
             chunk_starts = list(range(k * C, n, C))
+        # reprefill detection: a full chunk about to be computed whose
+        # content hash register() once published means the cache HAD this
+        # K/V and evicted it — repeated work, not a cold miss.  (full_cover
+        # plans re-run one chunk for logits only; that is inherent, not
+        # waste.  share=False is the disagg handoff — no local compute.)
+        reprefill = 0
+        if share and self.prefix is not None and not full_cover:
+            for start in chunk_starts:
+                if (start + C <= n
+                        and self._chunk_key(prompt, start)
+                        in self._seen_chunks):
+                    reprefill += C
+        self.reprefill_tokens += reprefill
         plan = AdmitPlan(
             prompt_len=n, budget=budget, chunk_starts=chunk_starts,
             null_target=full_cover, prefix_tokens=prefix_tokens,
-            shared_tail=tail_page is not None,
+            shared_tail=tail_page is not None, reprefill_tokens=reprefill,
         )
         self._plans[slot] = plan
         return plan
+
+    def _chunk_key(self, prompt, start: int) -> int:
+        """Content key for the page-aligned chunk at ``start``: the hash
+        covers the WHOLE prefix through the chunk's end, because a chunk's
+        K/V depends on everything before it."""
+        return hash(tuple(prompt[:start + self.page_len]))
 
     # -- prefill support -----------------------------------------------------
     def chunk_row(self, slot: int, start: int, null_target: bool) -> np.ndarray:
@@ -199,6 +231,12 @@ class PagedKVPool:
             return 0
         C = self.page_len
         full = len(prompt) // C
+        for i in range(full):
+            key = self._chunk_key(prompt, i * C)
+            self._seen_chunks[key] = None
+            self._seen_chunks.move_to_end(key)
+        while len(self._seen_chunks) > _SEEN_CHUNK_CAP:
+            self._seen_chunks.popitem(last=False)
         return self.prefix.insert(prompt, list(self.block_table[slot][:full]))
 
     def resolve_cow(self, slot: int) -> Optional[Tuple[int, int]]:
@@ -239,6 +277,7 @@ class PagedKVPool:
             "pages_used": self.allocator.used_count(),
             "page_len": self.page_len,
             "cow_copies": self.cow_copies,
+            "reprefill_tokens": self.reprefill_tokens,
         }
         if self.prefix is not None:
             p = self.prefix

@@ -8,33 +8,39 @@ name at construction; ``observability/dashboard.py`` folds
 engines of THAT process — a driver-embedded engine, or the test/bench
 harness.  Engines inside serve replica workers expose the same snapshot
 over the deployment's ``stats`` method instead (serve/engine_deployment.py).
+
+Latency distributions are airscope :class:`~tpu_air.observability.perf.
+Histogram` instances (log-bucketed, unwindowed, mergeable): the seed's
+256-sample deques + sorted-index quantiles are gone, p50/p95/p99 cover the
+engine's whole life, replica snapshots merge bucket-by-bucket
+(:func:`merge_snapshots`), and TTFT samples recorded with a ``trace_id``
+carry OpenMetrics exemplars that join a tail latency to its airtrace span
+tree.  Each instance also owns a :class:`~tpu_air.observability.perf.
+PerfLedger` the engine feeds per-program costs and goodput tokens into;
+its roofline/goodput state rides along in :meth:`snapshot` as ``perf``.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from typing import Any, Deque, Dict, Optional
+
 from collections import deque
-from typing import Any, Deque, Dict
+
+from tpu_air.observability.perf import (
+    Histogram,
+    PerfLedger,
+    ProgramCost,
+    cumulative_from_summary,
+    merge_ledger_snapshots,
+    merge_summaries,
+)
+from tpu_air.utils.metrics import ExpositionBuilder, sanitize_metric_name
 
 from .types import PRIORITIES
 
-_WINDOW = 256          # samples kept for the latency distributions
 _RATE_WINDOW_S = 10.0  # tokens/s horizon
-
-
-def _dist(samples) -> Dict[str, float]:
-    xs = sorted(samples)
-    if not xs:
-        return {"count": 0}
-    return {
-        "count": len(xs),
-        "mean": sum(xs) / len(xs),
-        "p50": xs[len(xs) // 2],
-        "p95": xs[min(len(xs) - 1, int(len(xs) * 0.95))],
-        "p99": xs[min(len(xs) - 1, int(len(xs) * 0.99))],
-        "max": xs[-1],
-    }
 
 
 class EngineMetrics:
@@ -53,19 +59,22 @@ class EngineMetrics:
         self.requests_completed = 0
         self.tokens_emitted = 0
         # per-priority-class breakdowns (SLO-aware serving): submits/sheds
-        # by class plus a per-class TTFT window, so the interactive p99 the
-        # admission controller and autoscaler steer on is visible directly
+        # by class plus a per-class TTFT histogram, so the interactive p99
+        # the admission controller and autoscaler steer on is visible
+        # directly
         self.submitted_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self.rejected_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
-        self._ttft_by_class: Dict[str, Deque[float]] = {
-            p: deque(maxlen=_WINDOW) for p in PRIORITIES
+        self._ttft_by_class: Dict[str, Histogram] = {
+            p: Histogram() for p in PRIORITIES
         }
         self.queue_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self.draining = False
         # distributions / rates
-        self._ttft_s: Deque[float] = deque(maxlen=_WINDOW)
-        self._step_s: Deque[float] = deque(maxlen=_WINDOW)
+        self._ttft_h = Histogram()
+        self._step_h = Histogram()
         self._token_stamps: Deque[Any] = deque()  # (t, n) for tokens/s
+        # roofline + goodput accumulator (engine records program costs)
+        self.ledger = PerfLedger()
         # paged-KV gauges (empty for slab engines — snapshot shape is then
         # unchanged from the slab era)
         self.kvpool: Dict[str, Any] = {}
@@ -119,12 +128,15 @@ class EngineMetrics:
         with self._lock:
             self.requests_completed += 1
 
-    def record_ttft(self, seconds: float,
-                    priority: str = "interactive") -> None:
+    def record_ttft(self, seconds: float, priority: str = "interactive",
+                    trace_id: Optional[str] = None) -> None:
+        """A first-token latency sample.  ``trace_id`` (when the request
+        was traced) becomes the histogram bucket's exemplar — the join key
+        from a dashboard tail-latency number to ``/api/traces?trace_id=``."""
         with self._lock:
-            self._ttft_s.append(seconds)
+            self._ttft_h.observe(seconds, trace_id)
             if priority in self._ttft_by_class:
-                self._ttft_by_class[priority].append(seconds)
+                self._ttft_by_class[priority].observe(seconds, trace_id)
 
     def record_tokens(self, tokens: int) -> None:
         """Count emitted tokens outside a pool step (prefill's first token)."""
@@ -137,10 +149,23 @@ class EngineMetrics:
     def record_step(self, seconds: float, tokens: int) -> None:
         now = time.monotonic()
         with self._lock:
-            self._step_s.append(seconds)
+            self._step_h.observe(seconds)
             self.tokens_emitted += tokens
             self._token_stamps.append((now, tokens))
             self._trim_stamps(now)
+
+    def record_program(self, kind: str, cost: ProgramCost,
+                       seconds: float) -> None:
+        """Ledger feed: one compiled-program execution's analytic cost and
+        measured wall time (engine.py's step/chunk instrumentation)."""
+        with self._lock:
+            self.ledger.record_program(kind, cost, seconds)
+
+    def record_goodput(self, category: str, n: int) -> None:
+        """Ledger feed: ``n`` tokens attributed to ``category`` ("useful"
+        or a wasted class — perf.WASTED_CATEGORIES)."""
+        with self._lock:
+            self.ledger.record_tokens(category, n)
 
     def _trim_stamps(self, now: float) -> None:
         horizon = now - _RATE_WINDOW_S
@@ -148,15 +173,16 @@ class EngineMetrics:
             self._token_stamps.popleft()
 
     def reset_window(self) -> None:
-        """Clear the latency windows and rate stamps (counters stay).  For
-        benches that warm jit caches through the engine and then measure a
-        clean steady-state window."""
+        """Clear the latency histograms, rate stamps and ledger (counters
+        stay).  For benches that warm jit caches through the engine and
+        then measure a clean steady-state window."""
         with self._lock:
-            self._ttft_s.clear()
-            self._step_s.clear()
+            self._ttft_h.reset()
+            self._step_h.reset()
             self._token_stamps.clear()
-            for q in self._ttft_by_class.values():
-                q.clear()
+            for h in self._ttft_by_class.values():
+                h.reset()
+            self.ledger.reset()
 
     # -- dashboard-side ------------------------------------------------------
     def tokens_per_s(self) -> float:
@@ -180,18 +206,19 @@ class EngineMetrics:
                 "requests_rejected": self.requests_rejected,
                 "requests_completed": self.requests_completed,
                 "tokens_emitted": self.tokens_emitted,
-                "ttft_s": _dist(self._ttft_s),
-                "step_latency_s": _dist(self._step_s),
+                "ttft_s": self._ttft_h.summary(),
+                "step_latency_s": self._step_h.summary(),
                 "draining": self.draining,
                 "priority": {
                     p: {
                         "submitted": self.submitted_by_class[p],
                         "shed": self.rejected_by_class[p],
                         "queue_depth": self.queue_by_class.get(p, 0),
-                        "ttft_s": _dist(self._ttft_by_class[p]),
+                        "ttft_s": self._ttft_by_class[p].summary(),
                     }
                     for p in PRIORITIES
                 },
+                "perf": self.ledger.snapshot(),
             }
             if self.kvpool:
                 out["kvpool"] = dict(self.kvpool)
@@ -225,8 +252,105 @@ def snapshot_all() -> Dict[str, Dict[str, Any]]:
     return {m.name: m.snapshot() for m in engines}
 
 
+def merge_snapshots(snapshots: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Fleet-level aggregate of engine snapshots (driver engines + serve
+    replicas): counters sum, histograms merge bucket-by-bucket — the
+    merged p99 is computed over EVERY replica's samples, not a max of
+    per-replica quantiles — and ledgers sum into one roofline/goodput
+    view.  Consumed by bench_serve's headline math and anything wanting
+    one number for the fleet."""
+    snaps = [s for s in snapshots.values() if s]
+    out: Dict[str, Any] = {"engines": len(snaps)}
+    for key in ("num_slots", "queue_depth", "slot_occupancy",
+                "requests_submitted", "requests_rejected",
+                "requests_completed", "tokens_emitted"):
+        out[key] = sum(int(s.get(key, 0)) for s in snaps)
+    out["tokens_per_s"] = sum(float(s.get("tokens_per_s", 0.0))
+                              for s in snaps)
+    out["ttft_s"] = merge_summaries([s.get("ttft_s") or {} for s in snaps])
+    out["step_latency_s"] = merge_summaries(
+        [s.get("step_latency_s") or {} for s in snaps])
+    prio: Dict[str, Any] = {}
+    for p in PRIORITIES:
+        entries = [(s.get("priority") or {}).get(p) or {} for s in snaps]
+        prio[p] = {
+            "submitted": sum(int(e.get("submitted", 0)) for e in entries),
+            "shed": sum(int(e.get("shed", 0)) for e in entries),
+            "queue_depth": sum(int(e.get("queue_depth", 0))
+                               for e in entries),
+            "ttft_s": merge_summaries([e.get("ttft_s") or {}
+                                       for e in entries]),
+        }
+    out["priority"] = prio
+    perfs = [s.get("perf") for s in snaps if s.get("perf")]
+    if perfs:
+        out["perf"] = merge_ledger_snapshots(perfs)
+    return out
+
+
+# -- prometheus exposition ---------------------------------------------------
+
+_FAMILIES = [
+    # (family, type, help)
+    ("tpu_air_engine_queue_depth", "gauge", "admission queue depth"),
+    ("tpu_air_engine_slot_occupancy", "gauge", "occupied decode slots"),
+    ("tpu_air_engine_requests_submitted", "counter", "requests accepted"),
+    ("tpu_air_engine_requests_rejected", "counter",
+     "requests shed under backpressure"),
+    ("tpu_air_engine_requests_completed", "counter", "requests retired"),
+    ("tpu_air_engine_tokens_emitted", "counter", "tokens streamed out"),
+    ("tpu_air_engine_tokens_per_s", "gauge",
+     "emitted tokens/s over the rate window"),
+    ("tpu_air_engine_ttft_s", "histogram",
+     "time to first token, seconds (log buckets, trace exemplars)"),
+    ("tpu_air_engine_ttft_s_p50", "gauge", "TTFT p50 seconds"),
+    ("tpu_air_engine_ttft_s_p95", "gauge", "TTFT p95 seconds"),
+    ("tpu_air_engine_ttft_s_p99", "gauge", "TTFT p99 seconds"),
+    ("tpu_air_engine_step_latency_s", "histogram",
+     "pool decode step wall time, seconds"),
+    ("tpu_air_engine_step_latency_s_p50", "gauge",
+     "decode step p50 seconds"),
+    ("tpu_air_engine_step_latency_s_p95", "gauge",
+     "decode step p95 seconds"),
+    ("tpu_air_engine_draining", "gauge",
+     "1 while the engine refuses new submissions"),
+    ("tpu_air_engine_priority_submitted", "counter",
+     "requests accepted per priority class"),
+    ("tpu_air_engine_priority_shed", "counter",
+     "requests shed per priority class"),
+    ("tpu_air_engine_priority_queue_depth", "gauge",
+     "queued requests per priority class"),
+    ("tpu_air_engine_priority_ttft_s", "histogram",
+     "per-priority-class TTFT seconds"),
+    ("tpu_air_engine_priority_ttft_s_p50", "gauge",
+     "per-class TTFT p50 seconds"),
+    ("tpu_air_engine_priority_ttft_s_p99", "gauge",
+     "per-class TTFT p99 seconds"),
+    ("tpu_air_engine_reordered_admits", "counter",
+     "admissions taken out of FIFO order"),
+    ("tpu_air_engine_prefill_chunks", "counter",
+     "prefill chunk programs executed"),
+    ("tpu_air_engine_roofline_fraction", "gauge",
+     "achieved fraction of the analytic roofline (perf ledger totals)"),
+    ("tpu_air_engine_flops_per_s", "gauge",
+     "achieved model flops/s (analytic cost over measured wall time)"),
+    ("tpu_air_engine_hbm_bytes_per_s", "gauge",
+     "achieved HBM bytes/s (analytic cost over measured wall time)"),
+    ("tpu_air_engine_program_roofline_fraction", "gauge",
+     "per compiled-program roofline fraction"),
+    ("tpu_air_engine_goodput_ratio", "gauge",
+     "useful / (useful + wasted) emitted tokens"),
+    ("tpu_air_engine_tokens_useful", "counter",
+     "tokens retired on streams that completed normally"),
+    ("tpu_air_engine_tokens_wasted", "counter",
+     "tokens whose work was wasted, by category"),
+]
+
+
 def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
-    """Engine gauges in prometheus text form (dashboard /metrics).
+    """Engine gauges in prometheus text form (dashboard /metrics), one
+    ``# HELP``/``# TYPE`` header per family, histogram families with full
+    ``_bucket``/``_sum``/``_count`` series and OpenMetrics exemplars.
 
     ``snapshots`` defaults to this process's registry; the dashboard passes
     a merged dict that also folds in serve-replica snapshots (keys there are
@@ -234,7 +358,11 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
     fine after quote-escaping)."""
     if snapshots is None:
         snapshots = snapshot_all()
-    lines = []
+    b = ExpositionBuilder()
+    for fam, mtype, help_text in _FAMILIES:
+        b.declare(fam, mtype, help_text)
+    kvpool_declared = set()
+    topo_declared = set()
     for name, snap in sorted(snapshots.items()):
         if not snap:
             continue
@@ -244,51 +372,98 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
                     "requests_rejected", "requests_completed",
                     "tokens_emitted"):
             if key in snap:
-                lines.append(f"tpu_air_engine_{key}{tag} {snap[key]}")
+                b.raw(f"tpu_air_engine_{key}",
+                      f"tpu_air_engine_{key}{tag} {snap[key]}")
         if "tokens_per_s" in snap:
-            lines.append(f"tpu_air_engine_tokens_per_s{tag} "
-                         f"{snap['tokens_per_s']:.3f}")
-        for dist_key in ("ttft_s", "step_latency_s"):
+            b.raw("tpu_air_engine_tokens_per_s",
+                  f"tpu_air_engine_tokens_per_s{tag} "
+                  f"{snap['tokens_per_s']:.3f}")
+        for dist_key, quantiles in (("ttft_s", ("p50", "p95", "p99")),
+                                    ("step_latency_s", ("p50", "p95"))):
             d = snap.get(dist_key) or {}
-            if d.get("count"):
-                lines.append(
-                    f"tpu_air_engine_{dist_key}_p50{tag} {d['p50']:.6f}"
-                )
-                lines.append(
-                    f"tpu_air_engine_{dist_key}_p95{tag} {d['p95']:.6f}"
-                )
+            if not d.get("count"):
+                continue
+            fam = f"tpu_air_engine_{dist_key}"
+            for q in quantiles:
+                if q in d:
+                    b.raw(f"{fam}_{q}", f"{fam}_{q}{tag} {d[q]:.6f}")
+            if d.get("buckets"):
+                b.histogram(fam, {"engine": name},
+                            cumulative_from_summary(d),
+                            int(d["count"]), float(d.get("sum", 0.0)))
         # paged-KV pool gauges (absent on slab engines)
         for key, val in sorted((snap.get("kvpool") or {}).items()):
             if isinstance(val, bool) or not isinstance(val, (int, float)):
                 continue
-            lines.append(f"tpu_air_engine_kvpool_{key}{tag} {val:g}")
+            fam = f"tpu_air_engine_kvpool_{key}"
+            if fam not in kvpool_declared:
+                b.declare(fam, "gauge", f"paged KV pool: {key}")
+                kvpool_declared.add(fam)
+            b.raw(fam, f"{fam}{tag} {val:g}")
         for key in ("reordered_admits", "prefill_chunks"):
             if key in snap:
-                lines.append(f"tpu_air_engine_{key}{tag} {snap[key]}")
+                b.raw(f"tpu_air_engine_{key}",
+                      f"tpu_air_engine_{key}{tag} {snap[key]}")
         if "draining" in snap:
-            lines.append(
-                f"tpu_air_engine_draining{tag} {int(bool(snap['draining']))}")
+            b.raw("tpu_air_engine_draining",
+                  f"tpu_air_engine_draining{tag} "
+                  f"{int(bool(snap['draining']))}")
         # per-priority-class counters/gauges ({engine=...,priority=...})
         for prio, pc in sorted((snap.get("priority") or {}).items()):
             ptag = f'{{engine="{label}",priority="{prio}"}}'
             for key in ("submitted", "shed", "queue_depth"):
                 if key in pc:
-                    lines.append(
-                        f"tpu_air_engine_priority_{key}{ptag} {pc[key]}")
+                    b.raw(f"tpu_air_engine_priority_{key}",
+                          f"tpu_air_engine_priority_{key}{ptag} {pc[key]}")
             d = pc.get("ttft_s") or {}
             if d.get("count"):
-                lines.append(
-                    f"tpu_air_engine_priority_ttft_s_p50{ptag} "
-                    f"{d['p50']:.6f}")
-                lines.append(
-                    f"tpu_air_engine_priority_ttft_s_p99{ptag} "
-                    f"{d['p99']:.6f}")
+                b.raw("tpu_air_engine_priority_ttft_s_p50",
+                      f"tpu_air_engine_priority_ttft_s_p50{ptag} "
+                      f"{d['p50']:.6f}")
+                b.raw("tpu_air_engine_priority_ttft_s_p99",
+                      f"tpu_air_engine_priority_ttft_s_p99{ptag} "
+                      f"{d['p99']:.6f}")
+                if d.get("buckets"):
+                    b.histogram("tpu_air_engine_priority_ttft_s",
+                                {"engine": name, "priority": prio},
+                                cumulative_from_summary(d),
+                                int(d["count"]), float(d.get("sum", 0.0)))
+        # perf ledger: roofline totals, per-program fractions, goodput
+        perf = snap.get("perf") or {}
+        totals = perf.get("totals") or {}
+        if totals.get("seconds"):
+            b.raw("tpu_air_engine_roofline_fraction",
+                  f"tpu_air_engine_roofline_fraction{tag} "
+                  f"{totals['roofline_fraction']:.6f}")
+            b.raw("tpu_air_engine_flops_per_s",
+                  f"tpu_air_engine_flops_per_s{tag} "
+                  f"{totals['flops_per_s']:.6g}")
+            b.raw("tpu_air_engine_hbm_bytes_per_s",
+                  f"tpu_air_engine_hbm_bytes_per_s{tag} "
+                  f"{totals['bytes_per_s']:.6g}")
+            for kind, p in sorted((perf.get("programs") or {}).items()):
+                b.raw("tpu_air_engine_program_roofline_fraction",
+                      f"tpu_air_engine_program_roofline_fraction"
+                      f'{{engine="{label}",program="{kind}"}} '
+                      f"{p['roofline_fraction']:.6f}")
+        goodput = perf.get("goodput") or {}
+        if goodput.get("total"):
+            b.raw("tpu_air_engine_goodput_ratio",
+                  f"tpu_air_engine_goodput_ratio{tag} "
+                  f"{goodput['goodput_ratio']:.6f}")
+            b.raw("tpu_air_engine_tokens_useful",
+                  f"tpu_air_engine_tokens_useful{tag} "
+                  f"{goodput.get('useful', 0)}")
+            for cat, n in sorted(goodput.items()):
+                if cat in ("total", "wasted", "useful", "goodput_ratio"):
+                    continue
+                b.raw("tpu_air_engine_tokens_wasted",
+                      f"tpu_air_engine_tokens_wasted"
+                      f'{{engine="{label}",category="{cat}"}} {n}')
         # topology: strings fold into one info line's labels, numbers
         # (replica counts, device counts) become gauges
         topo = snap.get("topology") or {}
         if topo:
-            from tpu_air.utils.metrics import sanitize_metric_name
-
             info = [f'engine="{label}"']
             for key, val in sorted(topo.items()):
                 # keys become metric-name / label-name fragments: sanitize.
@@ -298,8 +473,16 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
                     sval = str(val).replace("\\", "\\\\").replace('"', '\\"')
                     info.append(f'{skey}="{sval}"')
                 else:
-                    lines.append(
-                        f"tpu_air_engine_topology_{skey}{tag} {val:g}")
-            lines.append(
-                "tpu_air_engine_topology_info{" + ",".join(info) + "} 1")
-    return lines
+                    fam = f"tpu_air_engine_topology_{skey}"
+                    if fam not in topo_declared:
+                        b.declare(fam, "gauge", f"engine topology: {skey}")
+                        topo_declared.add(fam)
+                    b.raw(fam, f"{fam}{tag} {val:g}")
+            fam = "tpu_air_engine_topology_info"
+            if fam not in topo_declared:
+                b.declare(fam, "gauge",
+                          "engine placement metadata as labels")
+                topo_declared.add(fam)
+            b.raw(fam, "tpu_air_engine_topology_info{"
+                  + ",".join(info) + "} 1")
+    return b.lines()

@@ -9,11 +9,17 @@ endpoint over the driver runtime's live state (SURVEY.md §2B dashboard row,
 
 from .dashboard import start_dashboard, stop_dashboard, snapshot
 from .profiler import profile_trace, step_timer
+from . import perf
+from . import postmortem
+from . import slo
 from . import tracing
 from . import trace_export
 
 __all__ = [
+    "perf",
+    "postmortem",
     "profile_trace",
+    "slo",
     "snapshot",
     "start_dashboard",
     "step_timer",

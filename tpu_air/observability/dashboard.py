@@ -9,8 +9,11 @@ Install_locally.md:64-67):
                     driver-local engines merged with serve-replica snapshots
   /api/traces       recent trace summaries; ?trace_id=... for one trace's spans
   /api/traces/export  chrome://tracing-loadable JSON (docs/OBSERVABILITY.md)
+  /api/slo          airscope SLO burn-rate state (observability/slo.py),
+                    evaluated against the live engine gauges on each GET
   /api/version      framework version
-  /metrics          prometheus text exposition of the cluster + engine gauges
+  /metrics          prometheus text exposition (OpenMetrics-style HELP/TYPE
+                    headers; engine TTFT histograms carry trace exemplars)
 """
 
 from __future__ import annotations
@@ -149,29 +152,72 @@ def trace_payload(query: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
-def _prometheus_text() -> str:
-    snap = snapshot()
-    lines = []
-    if snap.get("initialized"):
-        lines += [
-            f"tpu_air_cpus_total {snap['resources']['cpu']}",
-            f"tpu_air_chips_total {snap['resources']['chip']}",
-            f"tpu_air_cpus_available {snap['available'].get('cpu', 0)}",
-            f"tpu_air_chips_available {snap['available'].get('chip', 0)}",
-            f"tpu_air_queue_depth {snap['queue_depth']}",
-            f"tpu_air_workers {len(snap['workers'])}",
-            f"tpu_air_actors {len(snap['actors'])}",
-        ]
-        ost = object_stats()
-        lines.append(f"tpu_air_store_file_objects {ost.get('file_objects', 0)}")
-        lines.append(f"tpu_air_store_file_bytes {ost.get('file_bytes', 0)}")
-        if "arena" in ost:
-            from tpu_air.utils.metrics import sanitize_metric_name
+def slo_payload() -> Dict[str, Any]:
+    """The /api/slo payload: every registered SLO's multi-window burn-rate
+    state, freshly evaluated against the live engine gauges.  A scrape IS a
+    sample: each GET appends one (good, total) point to the monitor's
+    history, so the windows fill at the polling cadence."""
+    from . import slo as slo_mod
 
+    mon = slo_mod.ensure_default(engine_stats)
+    mon.observe()
+    return {"slos": mon.state(), "burning": list(mon.burning())}
+
+
+# every non-engine family /metrics can emit, with its exposition type and
+# HELP text (engine families live in engine/metrics.py next to their data)
+_CLUSTER_FAMILIES = [
+    ("tpu_air_cpus_total", "gauge", "CPU slots the runtime was initialized with."),
+    ("tpu_air_chips_total", "gauge", "Accelerator chips the runtime was initialized with."),
+    ("tpu_air_cpus_available", "gauge", "CPU slots not currently leased."),
+    ("tpu_air_chips_available", "gauge", "Chips not currently leased."),
+    ("tpu_air_queue_depth", "gauge", "Tasks waiting for placement in the driver queue."),
+    ("tpu_air_workers", "gauge", "Worker processes registered with the runtime."),
+    ("tpu_air_actors", "gauge", "Live actors registered with the runtime."),
+    ("tpu_air_store_file_objects", "gauge", "Objects resident in the file-backed store."),
+    ("tpu_air_store_file_bytes", "gauge", "Bytes resident in the file-backed store."),
+]
+_SERVE_FAMILIES = [
+    ("tpu_air_serve_admission_admitted", "counter",
+     "Requests admitted by the serve proxy, by route and priority class."),
+    ("tpu_air_serve_admission_queued", "counter",
+     "Requests queued at admission, by route and priority class."),
+    ("tpu_air_serve_admission_shed", "counter",
+     "Requests shed at admission, by route and priority class."),
+    ("tpu_air_serve_queue_depth_per_replica", "gauge",
+     "Mean admission-queue depth per live replica, by route."),
+    ("tpu_air_serve_replicas", "gauge", "Live replicas, by route."),
+    ("tpu_air_serve_scale_ups", "counter", "Autoscaler scale-up actions, by route."),
+    ("tpu_air_serve_scale_downs", "counter", "Autoscaler scale-down actions, by route."),
+]
+
+
+def _prometheus_text() -> str:
+    from tpu_air.utils.metrics import ExpositionBuilder, sanitize_metric_name
+
+    b = ExpositionBuilder()
+    for fam, mtype, help_text in _CLUSTER_FAMILIES + _SERVE_FAMILIES:
+        b.declare(fam, mtype, help_text)
+    snap = snapshot()
+    lines: list = []
+    if snap.get("initialized"):
+        b.sample("tpu_air_cpus_total", {}, snap["resources"]["cpu"])
+        b.sample("tpu_air_chips_total", {}, snap["resources"]["chip"])
+        b.sample("tpu_air_cpus_available", {}, snap["available"].get("cpu", 0))
+        b.sample("tpu_air_chips_available", {}, snap["available"].get("chip", 0))
+        b.sample("tpu_air_queue_depth", {}, snap["queue_depth"])
+        b.sample("tpu_air_workers", {}, len(snap["workers"]))
+        b.sample("tpu_air_actors", {}, len(snap["actors"]))
+        ost = object_stats()
+        b.sample("tpu_air_store_file_objects", {}, ost.get("file_objects", 0))
+        b.sample("tpu_air_store_file_bytes", {}, ost.get("file_bytes", 0))
+        if "arena" in ost:
             for k, v in ost["arena"].items():
                 # arena stat keys are free-form (may carry dots/dashes);
                 # they must still land as valid prometheus identifiers
-                lines.append(f"tpu_air_arena_{sanitize_metric_name(k)} {v}")
+                fam = f"tpu_air_arena_{sanitize_metric_name(k)}"
+                b.declare(fam, "gauge", f"Shared-memory arena stat {k}.")
+                b.sample(fam, {}, v)
     # engine gauges live OUTSIDE the initialized check: an engine embedded
     # in this process (tests, bench, notebook) exports metrics even when the
     # cluster runtime was never brought up.  engine_stats() also folds in
@@ -190,26 +236,30 @@ def _prometheus_text() -> str:
         adm = ctl.get("admission") or {}
         for outcome in ("admitted", "queued", "shed"):
             for klass, n in (adm.get(outcome) or {}).items():
-                lines.append(
-                    f'tpu_air_serve_admission_{outcome}'
-                    f'{{route="{route}",priority="{klass}"}} {n}')
+                b.sample(f"tpu_air_serve_admission_{outcome}",
+                         {"route": route, "priority": klass}, n)
         g = adm.get("gauges") or {}
         if g:
-            lines.append(
-                f'tpu_air_serve_queue_depth_per_replica{{route="{route}"}} '
-                f'{g.get("depth_per_replica", 0)}')
+            b.sample("tpu_air_serve_queue_depth_per_replica",
+                     {"route": route}, g.get("depth_per_replica", 0))
         sc = ctl.get("autoscaler")
         if sc:
-            lines.append(
-                f'tpu_air_serve_replicas{{route="{route}"}} '
-                f'{sc.get("replicas", 0)}')
-            lines.append(
-                f'tpu_air_serve_scale_ups{{route="{route}"}} '
-                f'{sc.get("scale_ups", 0)}')
-            lines.append(
-                f'tpu_air_serve_scale_downs{{route="{route}"}} '
-                f'{sc.get("scale_downs", 0)}')
-    return "\n".join(lines) + "\n"
+            b.sample("tpu_air_serve_replicas", {"route": route},
+                     sc.get("replicas", 0))
+            b.sample("tpu_air_serve_scale_ups", {"route": route},
+                     sc.get("scale_ups", 0))
+            b.sample("tpu_air_serve_scale_downs", {"route": route},
+                     sc.get("scale_downs", 0))
+    # SLO burn-rate families (the monitor is its own exposition source so
+    # the /api/slo JSON and the prometheus lines can never disagree); a
+    # /metrics scrape doubles as a burn-rate sample, same as /api/slo
+    from . import slo as slo_mod
+
+    mon = slo_mod.ensure_default(engine_stats)
+    mon.observe()
+    slo_lines = mon.prometheus_lines()
+    out = b.lines() + lines + slo_lines
+    return "\n".join(out) + "\n"
 
 
 _INDEX_HTML = """<!doctype html><html><head><title>tpu_air dashboard</title></head>
@@ -220,6 +270,7 @@ _INDEX_HTML = """<!doctype html><html><head><title>tpu_air dashboard</title></he
 <a href="/api/serve">/api/serve</a> ·
 <a href="/api/traces">/api/traces</a> ·
 <a href="/api/traces/export">/api/traces/export</a> ·
+<a href="/api/slo">/api/slo</a> ·
 <a href="/api/version">/api/version</a> ·
 <a href="/metrics">/metrics</a></p>
 <pre id="s"></pre>
@@ -272,6 +323,9 @@ class _Handler(BaseHTTPRequestHandler):
                     trace_export.export_json(trace_id=trace_id).encode(),
                     "application/json",
                 )
+            elif path == "/api/slo":
+                self._send(200, json.dumps(slo_payload()).encode(),
+                           "application/json")
             elif path == "/api/version":
                 import tpu_air
 

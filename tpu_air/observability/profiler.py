@@ -15,6 +15,7 @@ import time
 from typing import Any, Dict, Iterator, Optional
 
 from . import tracing as _tracing
+from .perf import Histogram
 
 
 class step_timer:
@@ -25,6 +26,11 @@ class step_timer:
     ...     ...
     >>> t.summary()  # {'steps': N, 'mean_s': ..., 'p50_s': ..., 'p95_s': ...}
 
+    Quantiles come from an airscope log-bucketed :class:`Histogram` — the
+    same estimator the engine metrics use, so a trainer's p95 and the
+    dashboard's p95 agree on method (the raw ``durations`` list stays
+    available for exact math downstream).
+
     With ``span_name`` set AND tracing enabled, every step additionally
     lands as an airtrace span (parented under the ambient context) so the
     same numbers show up on the request/trial timeline; the default path
@@ -33,6 +39,7 @@ class step_timer:
 
     def __init__(self, span_name: Optional[str] = None):
         self.durations: list = []
+        self._hist = Histogram()
         self._span_name = span_name
 
     @contextlib.contextmanager
@@ -43,6 +50,7 @@ class step_timer:
         finally:
             dt = time.perf_counter() - t0
             self.durations.append(dt)
+            self._hist.observe(dt)
             if self._span_name is not None and _tracing.enabled():
                 end = _tracing.now_ns()
                 ctx = _tracing.current_context()
@@ -56,17 +64,16 @@ class step_timer:
                 )
 
     def summary(self) -> Dict[str, Any]:
-        if not self.durations:
+        s = self._hist.summary()
+        if not s.get("count"):
             return {"steps": 0}
-        d = sorted(self.durations)
-        n = len(d)
         return {
-            "steps": n,
-            "total_s": sum(d),
-            "mean_s": sum(d) / n,
-            "p50_s": d[n // 2],
-            "p95_s": d[min(n - 1, int(n * 0.95))],
-            "max_s": d[-1],
+            "steps": s["count"],
+            "total_s": s["sum"],
+            "mean_s": s["mean"],
+            "p50_s": s["p50"],
+            "p95_s": s["p95"],
+            "max_s": s["max"],
         }
 
 

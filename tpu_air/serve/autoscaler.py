@@ -12,6 +12,14 @@ loop) that scales one deployment between ``min_replicas`` and
 * **TTFT budget** — when ``ttft_budget_s`` is set and the interactive
   class's observed p99 TTFT exceeds it, scale up even if queues look
   shallow (latency is the SLO, queue depth only its proxy).
+* **SLO burn rate** — when an airscope SLO monitor reports an objective
+  burning on every evaluation window (observability/slo.py), scale up:
+  the burn-rate signal fires on *error-budget spend velocity*, which
+  catches a slow degradation a raw p99 threshold misses and stays quiet
+  through brief spikes a p99 threshold would overreact to.
+  ``slo_source`` is injectable like ``gauge_source``; by default the
+  process-wide installed monitor (``observability.slo.install``) is
+  consulted, so wiring a monitor up is enough.
 
 Scale-DOWN is deliberately timid: only after ``scale_down_idle_ticks``
 CONSECUTIVE ticks with empty queues and zero slot occupancy, and never
@@ -31,7 +39,19 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass
 from time import monotonic
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+
+def _installed_monitor_burning() -> Tuple[str, ...]:
+    """Default ``slo_source``: sample + evaluate the process-wide airscope
+    SLO monitor, empty when none is installed."""
+    from tpu_air.observability import slo as _slo
+
+    mon = _slo.monitor()
+    if mon is None:
+        return ()
+    mon.observe()
+    return tuple(mon.burning())
 
 
 @dataclass(frozen=True)
@@ -62,7 +82,8 @@ class Autoscaler:
     """One deployment's scaling loop (see module doc)."""
 
     def __init__(self, handle, config: Optional[AutoscalerConfig] = None, *,
-                 gauge_source: Optional[Callable[[], Dict[str, Any]]] = None):
+                 gauge_source: Optional[Callable[[], Dict[str, Any]]] = None,
+                 slo_source: Optional[Callable[[], Iterable[str]]] = None):
         self._handle = handle
         self.config = config or AutoscalerConfig()
         if self.config.min_replicas < 1:
@@ -70,6 +91,9 @@ class Autoscaler:
         if self.config.max_replicas < self.config.min_replicas:
             raise ValueError("max_replicas must be >= min_replicas")
         self._gauge_source = gauge_source or handle.engine_stats
+        # returns the names of SLOs currently burning (scale-up signal);
+        # default reads whatever monitor the app installed process-wide
+        self._slo_source = slo_source or _installed_monitor_burning
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # decision state below is written by the tick thread and read by
@@ -81,12 +105,17 @@ class Autoscaler:
         self.scale_ups = 0
         self.scale_downs = 0
         self.last_decision = "hold"
+        self.last_burning: tuple = ()
 
     # -- pure policy ----------------------------------------------------------
     def decide(self, snapshots: Dict[str, Dict[str, Any]],
-               replicas: int) -> str:
+               replicas: int, burning: Iterable[str] = ()) -> str:
         """``"up"`` / ``"down"`` / ``"hold"`` for one tick's gauges.  Pure
         (no side effects, no cooldown) — the unit-testable core.
+
+        ``burning`` names SLOs whose error budget is burning on every
+        evaluation window (observability/slo.py); any entry is a scale-up
+        signal of equal rank with queue depth and the p99 budget.
 
         The idle streak that gates scale-down is tracked by :meth:`tick`;
         this method only answers whether THIS tick looks idle (``"down"``
@@ -100,6 +129,8 @@ class Autoscaler:
                         for s in snapshots.values())
         if replicas < cfg.max_replicas:
             if depth / max(replicas, 1) >= cfg.scale_up_queue_depth:
+                return "up"
+            if any(True for _ in burning):
                 return "up"
             if cfg.ttft_budget_s is not None:
                 p99 = self._interactive_p99(snapshots)
@@ -133,7 +164,11 @@ class Autoscaler:
         except Exception:  # noqa: BLE001 — a failed scrape must not kill the loop
             snapshots = {}
         replicas = self._handle.num_replicas()
-        decision = self.decide(snapshots, replicas)
+        try:
+            burning = tuple(self._slo_source() or ())
+        except Exception:  # noqa: BLE001 — a broken SLO source must not kill the loop
+            burning = ()
+        decision = self.decide(snapshots, replicas, burning)
         # the idle streak: only an unbroken run of idle ticks earns a
         # scale-down; any non-idle tick resets it
         with self._lock:
@@ -144,6 +179,7 @@ class Autoscaler:
             else:
                 self._idle_ticks = 0
             self.last_decision = decision
+            self.last_burning = burning
             if decision == "hold":
                 return "hold"
             if monotonic() - self._last_action_at < cfg.cooldown_s:
@@ -198,4 +234,5 @@ class Autoscaler:
                 "scale_downs": self.scale_downs,
                 "idle_ticks": self._idle_ticks,
                 "last_decision": self.last_decision,
+                "burning_slos": list(self.last_burning),
             }
