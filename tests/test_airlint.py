@@ -785,6 +785,403 @@ class TestJX006JitBoundaryEscape:
         assert_quiet(src, "JX006")
 
 
+class TestJX007ShapePolymorphicJit:
+    VIOLATION = """\
+        import jax
+        import jax.numpy as jnp
+
+        def _step(x):
+            return x * 2
+
+        step = jax.jit(_step)
+
+        def run():
+            for n in range(1, 9):
+                step(jnp.zeros((n, 4), jnp.float32))
+        """
+
+    CLEAN = """\
+        import jax
+        import jax.numpy as jnp
+
+        def _step(x):
+            return x * 2
+
+        step = jax.jit(_step)
+
+        def run():
+            for _ in range(1, 9):
+                step(jnp.zeros((128, 4), jnp.float32))
+        """
+
+    def test_loop_varying_shape_fires_with_witness(self):
+        f = assert_fires(self.VIOLATION, "JX007",
+                         "step(jnp.zeros((n, 4)")
+        assert "retraces" in f.message
+        df = f.dataflow
+        assert df["jit"] == "_step"
+        assert any("~n@" in s for s in df["signature"])
+        assert df["call_path"], "witness chain missing"
+        assert "run" in df["call_path"][0]
+
+    def test_fixed_shape_in_loop_is_quiet(self):
+        assert_quiet(self.CLEAN, "JX007")
+
+    def test_distinct_concrete_signatures_fire_at_the_jit_decl(self):
+        src = """\
+            import jax
+            import jax.numpy as jnp
+
+            def _step(x):
+                return x * 2
+
+            step = jax.jit(_step)
+
+            def a(): return step(jnp.zeros((4, 4), jnp.float32))
+            def b(): return step(jnp.zeros((8, 4), jnp.float32))
+            def c(): return step(jnp.zeros((16, 4), jnp.float32))
+            """
+        f = assert_fires(src, "JX007", "step = jax.jit(_step)")
+        assert "3 distinct concrete shape signatures" in f.message
+        sigs = f.dataflow["signatures"]
+        assert len(sigs) == 3
+        for s in sigs:
+            assert {"args", "site", "call_path"} <= set(s)
+
+    def test_two_signatures_are_not_a_storm(self):
+        src = """\
+            import jax
+            import jax.numpy as jnp
+
+            def _step(x):
+                return x * 2
+
+            step = jax.jit(_step)
+
+            def a(): return step(jnp.zeros((4, 4), jnp.float32))
+            def b(): return step(jnp.zeros((8, 4), jnp.float32))
+            """
+        assert_quiet(src, "JX007")
+
+    def test_symbolic_shapes_never_count_as_distinct(self):
+        # unknown dims could all be the same value at runtime: no proof
+        src = """\
+            import jax
+            import jax.numpy as jnp
+
+            def _step(x):
+                return x * 2
+
+            step = jax.jit(_step)
+
+            def a(n): return step(jnp.zeros((n, 4), jnp.float32))
+            def b(m): return step(jnp.zeros((m, 4), jnp.float32))
+            def c(k): return step(jnp.zeros((k, 4), jnp.float32))
+            """
+        assert_quiet(src, "JX007")
+
+    def test_varying_static_argnum_fires(self):
+        src = """\
+            import jax
+            import jax.numpy as jnp
+
+            def _step(x, k):
+                return x[:k]
+
+            step = jax.jit(_step, static_argnums=(1,))
+
+            def run(x):
+                for n in range(1, 9):
+                    step(x, n)
+            """
+        f = assert_fires(src, "JX007", "step(x, n)")
+        assert "static argnum 1" in f.message
+
+    def test_cross_module_storm(self, tmp_path):
+        """Three modules each feed one concrete shape into a shared jit
+        entry point — no single-file analyzer can count to three."""
+        (tmp_path / "shared.py").write_text(textwrap.dedent("""\
+            import jax
+
+            def _step(x):
+                return x * 2
+
+            step = jax.jit(_step)
+            """))
+        for n in (4, 8, 16):
+            (tmp_path / f"call{n}.py").write_text(textwrap.dedent(f"""\
+                import jax.numpy as jnp
+                import shared
+
+                def go():
+                    return shared.step(jnp.zeros(({n}, 4), jnp.float32))
+                """))
+        reports = analyze_paths([str(tmp_path)], only=["JX007"])
+        hits = [f for rep in reports for f in rep.active]
+        assert len(hits) == 1, [f.message for f in hits]
+        assert hits[0].path.endswith("shared.py")
+        assert len(hits[0].dataflow["signatures"]) == 3
+        sites = {s["site"] for s in hits[0].dataflow["signatures"]}
+        assert len(sites) == 3
+
+
+class TestJX008ShardingAxisMismatch:
+    VIOLATION = """\
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def shardings(devs):
+            mesh = Mesh(devs, ("data", "model"))
+            return NamedSharding(mesh, P("data", "tensor"))
+        """
+
+    CLEAN = """\
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def shardings(devs):
+            mesh = Mesh(devs, ("data", "model"))
+            return NamedSharding(mesh, P("data", "model"))
+        """
+
+    def test_spec_axis_not_in_mesh_fires(self):
+        f = assert_fires(self.VIOLATION, "JX008", "NamedSharding(mesh,")
+        assert "'tensor'" in f.message
+        assert f.dataflow["mesh_axes"] == ["data", "model"]
+
+    def test_matching_axes_are_quiet(self):
+        assert_quiet(self.CLEAN, "JX008")
+
+    def test_collective_axis_unbound_by_shard_map_fires(self):
+        src = """\
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def body(x):
+                return jax.lax.psum(x, "model")
+
+            def outer(x, devs):
+                mesh = Mesh(devs, ("data",))
+                f = shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                              out_specs=P("data"))
+                return f(x)
+            """
+        f = assert_fires(src, "JX008", 'jax.lax.psum(x, "model")')
+        assert f.dataflow["axis_env"] == ["data"]
+        assert any("body" in link for link in f.dataflow["call_path"])
+
+    def test_collective_axis_bound_by_shard_map_is_quiet(self):
+        src = """\
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def body(x):
+                return jax.lax.psum(x, "data")
+
+            def outer(x, devs):
+                mesh = Mesh(devs, ("data",))
+                f = shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                              out_specs=P("data"))
+                return f(x)
+            """
+        assert_quiet(src, "JX008")
+
+    def test_unknown_mesh_is_quiet(self):
+        # the mesh comes in as a parameter: axes unknown, no proof
+        src = """\
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def shardings(mesh):
+                return NamedSharding(mesh, P("data", "tensor"))
+            """
+        assert_quiet(src, "JX008")
+
+
+class TestJX009DonationDropped:
+    VIOLATION = """\
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            return x[:4]
+
+        def _step(x):
+            return helper(x)
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        def main():
+            x = jnp.zeros((8,), jnp.float32)
+            return step(x)
+        """
+
+    CLEAN = """\
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            return x * 2
+
+        def _step(x):
+            return helper(x)
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        def main():
+            x = jnp.zeros((8,), jnp.float32)
+            return step(x)
+        """
+
+    def test_interprocedural_shape_mismatch_fires(self):
+        """The output shape is only known after inlining helper() inside
+        the jitted body — a per-function analyzer sees nothing."""
+        f = assert_fires(self.VIOLATION, "JX009", "return step(x)")
+        assert f.dataflow["donated"] == "f32[8]"
+        assert f.dataflow["outputs"] == ["f32[4]"]
+        assert "main" in f.dataflow["call_path"][0]
+
+    def test_matching_output_aliases_and_is_quiet(self):
+        assert_quiet(self.CLEAN, "JX009")
+
+    def test_dtype_mismatch_fires(self):
+        src = """\
+            import jax
+            import jax.numpy as jnp
+
+            def _step(x):
+                return x.astype(jnp.bfloat16)
+
+            step = jax.jit(_step, donate_argnums=(0,))
+
+            def main():
+                return step(jnp.zeros((8, 8), jnp.float32))
+            """
+        f = assert_fires(src, "JX009", "return step(jnp.zeros")
+        assert f.dataflow["outputs"] == ["bf16[8,8]"]
+
+    def test_unknown_output_shape_is_quiet(self):
+        # helper is unresolvable: the donation may well alias
+        src = """\
+            import jax
+            import jax.numpy as jnp
+            from somewhere import helper
+
+            def _step(x):
+                return helper(x)
+
+            step = jax.jit(_step, donate_argnums=(0,))
+
+            def main():
+                return step(jnp.zeros((8,), jnp.float32))
+            """
+        assert_quiet(src, "JX009")
+
+
+class TestPL001VmemOverflow:
+    VIOLATION = """\
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(x_ref, o_ref, acc):
+            o_ref[...] = x_ref[...]
+
+        def big(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((1024, 1024), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((1024, 1024), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((4096, 1024), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((1024, 1024), jnp.float32)],
+            )(x)
+        """
+
+    CLEAN = """\
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(x_ref, o_ref, acc):
+            o_ref[...] = x_ref[...]
+
+        def small(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((512, 128), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)],
+            )(x)
+        """
+
+    def test_oversized_tiles_fire_with_breakdown(self):
+        f = assert_fires(self.VIOLATION, "PL001", "pl.pallas_call(")
+        df = f.dataflow
+        assert df["budget_bytes"] == 16 * 1024 * 1024
+        # 2×4MiB in (double-buffered) + 2×4MiB out + 4MiB scratch
+        assert df["total_bytes"] == 20 * 1024 * 1024
+        roles = {t["role"] for t in df["tiles"]}
+        assert roles == {"in[0]", "out[0]", "scratch[0]"}
+        scratch = next(t for t in df["tiles"] if t["role"] == "scratch[0]")
+        assert not scratch["double_buffered"]
+
+    def test_fitting_tiles_are_quiet(self):
+        assert_quiet(self.CLEAN, "PL001")
+
+    def test_symbolic_block_dims_are_quiet(self):
+        # tile sizes derived from a runtime shape: no concrete proof
+        src = """\
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def run(x):
+                b, d = x.shape
+                return pl.pallas_call(
+                    kernel,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((b, d), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((b, d), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct((b, d), jnp.float32),
+                )(x)
+            """
+        assert_quiet(src, "PL001")
+
+    def test_known_input_dtype_scales_the_footprint(self):
+        # 3072×1024 bf16 tiles: 6 MiB each side double-buffered = 24 MiB
+        src = """\
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def run():
+                x = jnp.zeros((8192, 1024), jnp.bfloat16)
+                return pl.pallas_call(
+                    kernel,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((3072, 1024), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((3072, 1024), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct((8192, 1024), jnp.bfloat16),
+                )(x)
+            """
+        f = assert_fires(src, "PL001", "pl.pallas_call(")
+        assert f.dataflow["total_bytes"] == 24 * 1024 * 1024
+        tile = next(t for t in f.dataflow["tiles"] if t["role"] == "in[0]")
+        assert tile["dtype"] == "bfloat16"
+
+
 class TestAL000ParseError:
     def test_syntax_error_is_a_finding(self):
         rep = analyze_source("def broken(:\n    pass\n", path="bad.py")
@@ -795,6 +1192,7 @@ class TestAL000ParseError:
 def test_every_rule_has_a_fixture():
     """Adding a rule without a fires+quiet fixture pair must fail CI."""
     covered = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
+               "JX007", "JX008", "JX009", "PL001",
                "RT001", "RT002", "RT003", "RT004",
                "CC001", "CC002", "CC003"}
     assert {r.id for r in all_rules()} == covered
@@ -1028,7 +1426,8 @@ def test_new_rules_self_application_zero_unsuppressed():
     rules over the repo's own tree report nothing unsuppressed, and every
     surviving suppression states its reason."""
     reports = analyze_paths([str(REPO / "tpu_air")],
-                            only=["CC001", "CC002", "CC003", "JX006"])
+                            only=["CC001", "CC002", "CC003", "JX006",
+                                  "JX007", "JX008", "JX009", "PL001"])
     active = [f for rep in reports for f in rep.active]
     assert not active, "unsuppressed dataflow findings:\n" + "\n".join(
         f"  {f.location()}: {f.rule}: {f.message}" for f in active)
@@ -1125,7 +1524,8 @@ class TestCLI:
         assert cli_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rid in ("JX001", "JX004", "RT001", "RT004",
-                    "CC001", "CC002", "CC003", "JX006"):
+                    "CC001", "CC002", "CC003", "JX006",
+                    "JX007", "JX008", "JX009", "PL001"):
             assert rid in out
 
     def test_changed_scopes_to_changed_files(self, tmp_path):
@@ -1193,6 +1593,98 @@ class TestCLI:
         assert {f["rule"] for f in doc["findings"]} == {"JX004"}
         assert all(f["path"].endswith("caller.py")
                    for f in doc["findings"])
+
+    def test_changed_skips_deleted_and_follows_renames(self, tmp_path):
+        """Deleting or renaming a tracked .py must not hand --changed a
+        dead path (which would surface as a spurious AL000 parse error);
+        the renamed file is analyzed under its new name."""
+        def git(*a):
+            subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                           capture_output=True, timeout=60)
+
+        git("init")
+        git("config", "user.email", "lint@example.com")
+        git("config", "user.name", "lint")
+        (tmp_path / "doomed.py").write_text("x = 1\n")
+        (tmp_path / "old_name.py").write_text(
+            textwrap.dedent(TestRT002MutateAfterPut.VIOLATION))
+        git("add", ".")
+        git("commit", "-m", "seed")
+        git("branch", "-M", "main")
+        git("checkout", "-b", "feature")
+        (tmp_path / "doomed.py").unlink()
+        git("mv", "old_name.py", "new_name.py")
+        git("commit", "-am", "delete + rename")
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "airlint.py"),
+             "--changed", "--json", "."],
+            capture_output=True, text=True, cwd=tmp_path, timeout=60)
+        assert proc.returncode == 1, proc.stderr
+        doc = json.loads(proc.stdout)
+        rules = {f["rule"] for f in doc["findings"]}
+        assert "AL000" not in rules, doc["findings"]
+        assert rules == {"RT002"}
+        assert all(f["path"].endswith("new_name.py")
+                   for f in doc["findings"])
+
+    def test_baseline_write_then_apply_round_trip(self, tmp_path, capsys):
+        """--baseline-write records today's findings; a later --baseline
+        run suppresses exactly those and exits 0."""
+        p = tmp_path / "legacy.py"
+        p.write_text(textwrap.dedent(TestRT002MutateAfterPut.VIOLATION))
+        base = tmp_path / "base.json"
+        assert cli_main([str(p), "--baseline", str(base),
+                         "--baseline-write"]) == 0
+        capsys.readouterr()
+        doc = json.loads(base.read_text())
+        assert doc["version"] == 1
+        (entry,) = doc["findings"]
+        assert entry["rule"] == "RT002"
+        assert {"rule", "path", "message"} == set(entry)
+        assert cli_main([str(p), "--json", "--baseline", str(base)]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["findings"] == []
+        (sup,) = out["suppressed"]
+        assert sup["rule"] == "RT002"
+        assert f"baseline ({base})" == sup["suppress_reason"]
+
+    def test_baseline_does_not_hide_new_findings(self, tmp_path, capsys):
+        """A finding introduced after the baseline was written still
+        fails the run — baselines freeze debt, they don't grow it."""
+        p = tmp_path / "legacy.py"
+        p.write_text(textwrap.dedent(TestRT002MutateAfterPut.VIOLATION))
+        base = tmp_path / "base.json"
+        assert cli_main([str(p), "--baseline", str(base),
+                         "--baseline-write"]) == 0
+        capsys.readouterr()
+        fresh = tmp_path / "fresh.py"
+        fresh.write_text(textwrap.dedent(TestJX004HostSyncInHotPath.VIOLATION))
+        assert cli_main([str(p), str(fresh), "--json",
+                         "--baseline", str(base)]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert {f["rule"] for f in out["findings"]} == {"JX004"}
+        assert {f["rule"] for f in out["suppressed"]} == {"RT002"}
+
+    def test_baseline_survives_line_shifts(self, tmp_path, capsys):
+        """The fingerprint is (rule, path, message) — edits above the
+        finding must not resurrect it.  (Uses JX004, whose message does
+        not embed line numbers; rules that do get a fresh fingerprint on
+        shift, which is the conservative direction.)"""
+        p = tmp_path / "legacy.py"
+        src = textwrap.dedent(TestJX004HostSyncInHotPath.VIOLATION)
+        p.write_text(src)
+        base = tmp_path / "base.json"
+        assert cli_main([str(p), "--baseline", str(base),
+                         "--baseline-write"]) == 0
+        capsys.readouterr()
+        p.write_text("# a new comment shifts every line\n" + src)
+        assert cli_main([str(p), "--baseline", str(base)]) == 0
+
+    def test_missing_baseline_is_a_usage_error(self, tmp_path, capsys):
+        p = tmp_path / "clean.py"
+        p.write_text("x = 1\n")
+        assert cli_main([str(p), "--baseline",
+                         str(tmp_path / "nope.json")]) == 2
 
     def test_tools_launcher_json_gate(self, tmp_path):
         """tools/airlint.py --json must exit nonzero on findings — this is

@@ -1,0 +1,210 @@
+"""Unit tests for the airshape abstract domain (dataflow/shapes.py).
+
+These exercise the lattice in isolation — join/widening on symbolic
+dimensions, the stable ``render`` signatures the JX007 storm counter
+keys on, broadcasting, and dimension arithmetic.  The end-to-end rule
+behaviour lives in tests/test_airlint.py; everything here must hold for
+those rules to be proofs rather than guesses.
+"""
+
+import ast
+
+import pytest
+
+from tpu_air.analysis.dataflow.shapes import (
+    ANYDIM,
+    ArrayVal,
+    DtypeVal,
+    IntVal,
+    NONE,
+    StrVal,
+    Sym,
+    TupleVal,
+    UNKNOWN,
+    _broadcast,
+    _dim_arith,
+    _footprint,
+    is_concrete,
+    join,
+    join_dim,
+    join_env,
+    render,
+)
+
+
+class TestRender:
+    """render() doubles as the memo/signature key: it must be stable and
+    must distinguish exactly what a retrace would distinguish."""
+
+    def test_concrete_array(self):
+        assert render(ArrayVal((4, 128), "float32")) == "f32[4,128]"
+        assert render(ArrayVal((8,), "bfloat16")) == "bf16[8]"
+        assert render(ArrayVal((2, 2), "int32")) == "i32[2,2]"
+
+    def test_symbolic_dim_keeps_its_name(self):
+        v = ArrayVal((Sym("q.shape[0]"), 64), "float32")
+        assert render(v) == "f32[q.shape[0],64]"
+
+    def test_varying_dim_is_marked(self):
+        v = ArrayVal((Sym("n@L3", varying=True), 4), "float32")
+        assert render(v) == "f32[~n@L3,4]"
+
+    def test_unknown_dtype(self):
+        assert render(ArrayVal((4,), None)) == "?[4]"
+
+    def test_scalars_and_tuples(self):
+        assert render(IntVal(7)) == "7"
+        assert render(StrVal("data")) == "'data'"
+        assert render(NONE) == "None"
+        assert render(TupleVal((IntVal(1), ArrayVal((2,), "float32")))) \
+            == "(1, f32[2])"
+
+    def test_unrenderable_degrades_to_question_mark(self):
+        assert render(UNKNOWN) == "?"
+
+
+class TestIsConcrete:
+    def test_fully_known_array(self):
+        assert is_concrete(ArrayVal((4, 128), "float32"))
+
+    def test_symbolic_dim_is_not_concrete(self):
+        assert not is_concrete(ArrayVal((Sym("n"), 128), "float32"))
+
+    def test_missing_dtype_is_not_concrete(self):
+        assert not is_concrete(ArrayVal((4,), None))
+
+    def test_tuple_is_concrete_iff_all_elements_are(self):
+        assert is_concrete(TupleVal((IntVal(1), StrVal("x"))))
+        assert not is_concrete(TupleVal((IntVal(1), UNKNOWN)))
+
+    def test_unknown_is_not_concrete(self):
+        assert not is_concrete(UNKNOWN)
+
+
+class TestJoin:
+    """join() is the widening applied at control-flow merges: loops run
+    once and join; branches join both arms."""
+
+    def test_equal_values_join_to_themselves(self):
+        a = ArrayVal((4, 8), "float32")
+        assert join(a, ArrayVal((4, 8), "float32")) == a
+
+    def test_differing_dims_widen_to_anydim(self):
+        out = join(ArrayVal((4, 8), "float32"), ArrayVal((16, 8), "float32"))
+        assert out.shape == (ANYDIM, 8)
+        assert out.dtype == "float32"
+        assert not is_concrete(out)
+
+    def test_varying_taints_the_joined_dim(self):
+        n = Sym("n@L3", varying=True)
+        out = join_dim(n, 4)
+        assert isinstance(out, Sym) and out.varying
+
+    def test_differing_dtypes_drop_the_dtype(self):
+        out = join(ArrayVal((4,), "float32"), ArrayVal((4,), "bfloat16"))
+        assert out.shape == (4,) and out.dtype is None
+
+    def test_rank_mismatch_is_unknown(self):
+        assert join(ArrayVal((4,), "float32"),
+                    ArrayVal((4, 4), "float32")) is UNKNOWN
+
+    def test_unknown_absorbs(self):
+        assert join(UNKNOWN, ArrayVal((4,), "float32")) is UNKNOWN
+
+    def test_tuples_join_elementwise(self):
+        out = join(TupleVal((IntVal(1), IntVal(2))),
+                   TupleVal((IntVal(1), IntVal(3))))
+        assert out.elts[0] == IntVal(1)
+        assert out.elts[1].value is ANYDIM
+
+    def test_join_env_keeps_only_common_bindings(self):
+        a = {"x": IntVal(1), "y": IntVal(2)}
+        b = {"x": IntVal(1), "z": IntVal(3)}
+        out = join_env(a, b)
+        assert set(out) == {"x"}
+        assert out["x"] == IntVal(1)
+
+
+class TestDimArith:
+    def test_concrete_arithmetic(self):
+        assert _dim_arith(ast.Add, 4, 4) == 8
+        assert _dim_arith(ast.FloorDiv, 9, 2) == 4
+
+    def test_division_by_zero_degrades(self):
+        assert _dim_arith(ast.FloorDiv, 9, 0) == 0
+        assert _dim_arith(ast.Mod, 9, 0) == 0
+
+    def test_huge_or_negative_exponent_degrades(self):
+        # 2 ** 10_000 would hang rendering; negative returns a float
+        assert _dim_arith(ast.Pow, 2, 10_000) == 0
+        assert _dim_arith(ast.Pow, 2, -1) == 0
+
+    def test_symbolic_operand_builds_a_named_sym(self):
+        out = _dim_arith(ast.Mult, Sym("n"), 2)
+        assert isinstance(out, Sym) and out.name == "n*2"
+        assert not out.varying
+
+    def test_varying_propagates_through_arithmetic(self):
+        out = _dim_arith(ast.Add, Sym("i@L1", varying=True), 1)
+        assert isinstance(out, Sym) and out.varying
+
+    def test_unknown_operator_is_anydim(self):
+        assert _dim_arith(ast.BitOr, 4, 4) is ANYDIM
+
+
+class TestBroadcast:
+    def test_scalar_like_broadcast(self):
+        out = _broadcast(ArrayVal((4, 8), "float32"),
+                         ArrayVal((1,), "float32"))
+        assert out.shape == (4, 8)
+
+    def test_rank_padding(self):
+        out = _broadcast(ArrayVal((8,), "float32"),
+                         ArrayVal((4, 8), "float32"))
+        assert out.shape == (4, 8)
+
+    def test_concrete_mismatch_is_unknown(self):
+        # a real shape error: not this analyzer's rule to report
+        assert _broadcast(ArrayVal((3,), "float32"),
+                          ArrayVal((4,), "float32")) is UNKNOWN
+
+    def test_symbolic_dim_joins(self):
+        out = _broadcast(ArrayVal((Sym("n"), 8), "float32"),
+                         ArrayVal((4, 8), "float32"))
+        assert out.shape[0] is ANYDIM or isinstance(out.shape[0], Sym)
+        assert out.shape[1] == 8
+
+
+class TestFootprint:
+    def test_dtype_width_scales_bytes(self):
+        assert _footprint((128, 128), "float32") == 128 * 128 * 4
+        assert _footprint((128, 128), "bfloat16") == 128 * 128 * 2
+        assert _footprint((128,), "int8") == 128
+
+    def test_unknown_dtype_assumes_four_bytes(self):
+        assert _footprint((10,), None) == 40
+
+    def test_symbolic_dim_is_unpriceable(self):
+        assert _footprint((Sym("n"), 128), "float32") is None
+
+
+class TestSymIdentity:
+    """Sym equality is structural: the same program point must produce
+    the same symbol so memoization and signature dedup work."""
+
+    def test_equal_name_and_varying_compare_equal(self):
+        assert Sym("n@L3", varying=True) == Sym("n@L3", varying=True)
+        assert Sym("n") != Sym("m")
+        assert Sym("n") != Sym("n", varying=True)
+
+    def test_sym_is_hashable(self):
+        assert len({Sym("a"), Sym("a"), Sym("b")}) == 2
+
+    def test_dtypeval_roundtrip(self):
+        assert render(DtypeVal("bfloat16")) == "bf16"
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

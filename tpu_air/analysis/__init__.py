@@ -36,6 +36,7 @@ from .context import ModuleContext
 from .dataflow import ProgramContext
 from .dataflow import rules_concurrency as _rules_cc  # noqa: E402,F401
 from .dataflow import rules_jitflow as _rules_jf  # noqa: E402,F401
+from .dataflow import rules_shapes as _rules_sh  # noqa: E402,F401
 from .suppressions import apply_suppressions, parse_suppressions
 
 

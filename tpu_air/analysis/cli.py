@@ -46,6 +46,12 @@ def _build_parser() -> argparse.ArgumentParser:
                         "`git merge-base HEAD main`)")
     p.add_argument("--rules", default=None, metavar="ID[,ID...]",
                    help="run only these rule ids")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="suppress findings recorded in FILE — only *new* "
+                        "findings fail the run (see --baseline-write)")
+    p.add_argument("--baseline-write", action="store_true",
+                   help="write the current findings to the --baseline file "
+                        "(default: airlint_baseline.json) and exit 0")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     p.add_argument("--show-suppressed", action="store_true",
@@ -70,17 +76,74 @@ def _git(args: List[str]) -> Optional[str]:
 
 def changed_files(base: Optional[str] = None) -> Optional[Set[str]]:
     """Python files changed vs ``base`` (default: merge-base with main),
-    plus untracked ones.  None when git is unusable here."""
+    plus untracked ones.  None when git is unusable here.
+
+    Deletions are dropped and renames are followed to their new name —
+    ``--changed`` must never hand the analyzer a path that no longer
+    exists (it would surface as a spurious AL000 parse error)."""
     if base is None:
         mb = _git(["merge-base", "HEAD", "main"])
         base = mb.strip() if mb else "HEAD"
-    diff = _git(["diff", "--name-only", base])
+    diff = _git(["diff", "--name-status", "-M", base])
     if diff is None:
         return None
+    paths = []
+    for line in diff.splitlines():
+        parts = line.split("\t")
+        if len(parts) < 2:
+            continue
+        status = parts[0]
+        if status.startswith("D"):
+            continue  # deleted: nothing to analyze
+        # renames/copies are "Rnnn\told\tnew" — the new name is last
+        paths.append(parts[-1])
     untracked = _git(["ls-files", "--others", "--exclude-standard"]) or ""
-    return {os.path.normpath(p)
-            for p in (diff.splitlines() + untracked.splitlines())
-            if p.endswith(".py")}
+    paths.extend(untracked.splitlines())
+    return {os.path.normpath(p) for p in paths
+            if p.endswith(".py") and os.path.isfile(p)}
+
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "airlint_baseline.json"
+
+
+def _fingerprint(f) -> tuple:
+    """Line-number independent identity: a baseline must survive edits
+    above the finding, so only (rule, file, message) participate."""
+    return (f.rule, os.path.normpath(f.path).replace(os.sep, "/"), f.message)
+
+
+def _write_baseline(path: str, reports) -> None:
+    entries = sorted({_fingerprint(f) for rep in reports for f in rep.active})
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({
+            "version": BASELINE_VERSION,
+            "findings": [{"rule": r, "path": p, "message": m}
+                         for r, p, m in entries],
+        }, fh, indent=2)
+        fh.write("\n")
+    print(f"airlint: wrote {len(entries)} finding(s) to {path}",
+          file=sys.stderr)
+
+
+def _apply_baseline(path: str, reports) -> Optional[int]:
+    """Mark baselined findings suppressed; count them.  None = bad file."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        known = {(e["rule"], e["path"], e["message"])
+                 for e in data["findings"]}
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"airlint: cannot read baseline {path}: {e}", file=sys.stderr)
+        return None
+    n = 0
+    for rep in reports:
+        for f in rep.active:
+            if _fingerprint(f) in known:
+                f.suppressed = True
+                f.suppress_reason = f"baseline ({path})"
+                n += 1
+    return n
 
 
 def _human(reports, show_suppressed: bool) -> None:
@@ -173,6 +236,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     except OSError as e:
         print(f"airlint: {e}", file=sys.stderr)
         return 2
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.baseline_write:
+        _write_baseline(baseline_path, reports)
+        return 0
+    if args.baseline is not None:
+        if _apply_baseline(args.baseline, reports) is None:
+            return 2
     if args.fmt == "json":
         _json_out(reports)
     elif args.fmt == "sarif":

@@ -25,6 +25,14 @@ from ..registry import get_rule
 from .callgraph import CallGraph, module_name  # noqa: F401 — re-export
 from .jitflow import JitFlowAnalysis
 from .lockset import LocksetAnalysis, RawFinding
+from .shapes import ShapeAnalysis
+
+# which program analysis produces each dataflow-backed rule's findings
+_ANALYSIS_FOR_RULE = {
+    "JX006": "jitflow",
+    "JX007": "shapes", "JX008": "shapes", "JX009": "shapes",
+    "PL001": "shapes",
+}
 
 
 class ProgramContext:
@@ -38,6 +46,7 @@ class ProgramContext:
         self._callgraph: Optional[CallGraph] = None
         self._lockset: Optional[LocksetAnalysis] = None
         self._jitflow: Optional[JitFlowAnalysis] = None
+        self._shapes: Optional[ShapeAnalysis] = None
 
     @property
     def callgraph(self) -> CallGraph:
@@ -59,14 +68,21 @@ class ProgramContext:
             self._jitflow.run()
         return self._jitflow
 
+    @property
+    def shapes(self) -> ShapeAnalysis:
+        if self._shapes is None:
+            self._shapes = ShapeAnalysis(self.callgraph)
+            self._shapes.run()
+        return self._shapes
+
     def module(self, path: str) -> Optional[ModuleContext]:
         return self._by_path.get(os.path.normpath(path))
 
     # -- findings ------------------------------------------------------------
     def findings_for(self, path: str, rule_id: str) -> List[Finding]:
         """Program-analysis findings of one rule, restricted to ``path``."""
-        raw = self.jitflow.findings if rule_id == "JX006" \
-            else self.lockset.findings
+        analysis = _ANALYSIS_FOR_RULE.get(rule_id, "lockset")
+        raw = getattr(self, analysis).findings
         norm = os.path.normpath(path)
         return [_to_finding(r) for r in raw
                 if r.rule == rule_id and os.path.normpath(r.path) == norm]

@@ -1,0 +1,42 @@
+"""JX007–JX009 and PL001 — surfaced from the program-wide
+:class:`~tpu_air.analysis.dataflow.shapes.ShapeAnalysis`."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..findings import Finding, Severity
+from ..registry import rule
+from . import ensure_program
+
+
+@rule("JX007", "shape-polymorphic-jit", Severity.WARNING,
+      "a jit entry point reached by loop-varying or many distinct "
+      "concrete shape signatures retraces and recompiles per signature — "
+      "a recompile storm that shows up as latency cliffs, not errors")
+def jx007_shape_polymorphic_jit(ctx) -> List[Finding]:
+    return ensure_program(ctx).findings_for(ctx.path, "JX007")
+
+
+@rule("JX008", "sharding-axis-mismatch", Severity.ERROR,
+      "a PartitionSpec or collective naming an axis the mesh/shard_map "
+      "context does not bind fails at trace time on hardware — or "
+      "silently no-ops on a stand-in mesh, hiding the parallelism bug")
+def jx008_sharding_axis_mismatch(ctx) -> List[Finding]:
+    return ensure_program(ctx).findings_for(ctx.path, "JX008")
+
+
+@rule("JX009", "donation-dropped", Severity.WARNING,
+      "a donated buffer whose shape/dtype matches no jit output cannot "
+      "alias, so XLA silently ignores the donation and both buffers stay "
+      "live — an HBM leak no runtime error ever surfaces")
+def jx009_donation_dropped(ctx) -> List[Finding]:
+    return ensure_program(ctx).findings_for(ctx.path, "JX009")
+
+
+@rule("PL001", "vmem-overflow", Severity.ERROR,
+      "Pallas block tiles and scratch must fit the per-core VMEM budget "
+      "(~16 MiB on TPU); an overflowing kernel fails to lower or "
+      "silently spills, losing the fusion's entire point")
+def pl001_vmem_overflow(ctx) -> List[Finding]:
+    return ensure_program(ctx).findings_for(ctx.path, "PL001")

@@ -1,0 +1,1578 @@
+"""airshape — abstract shape/dtype/sharding interpretation over the call graph.
+
+A small symbolic interpreter propagates ``(shape, dtype, PartitionSpec)``
+lattice values from config constants through function bodies, jit/pjit
+boundaries and the ``jnp``/``lax`` op surface.  Unknown dimensions become
+named symbols (``q.shape[0]``); dimensions derived from a loop variable are
+marked *varying* — provably different on every iteration.  Four rules read
+the collected events:
+
+- **JX007** shape-polymorphic-jit: a jit callsite reached by a loop-varying
+  shape (or a loop-varying value in a static argnum), or by ≥3 provably
+  distinct fully-concrete signatures — a recompile-storm proof with the
+  interprocedural witness chain.
+- **JX008** sharding-axis-mismatch: PartitionSpec/NamedSharding axis names
+  checked against the constructing mesh's axes; collective axis names
+  checked against the enclosing shard_map/pmap context when it is known.
+- **JX009** donation-dropped: a ``donate_argnums`` buffer whose abstract
+  shape/dtype matches no output cannot alias — XLA keeps both copies and
+  no runtime error ever surfaces the HBM leak.
+- **PL001** vmem-overflow: BlockSpec tile footprints (double-buffered) plus
+  scratch shapes at each ``pl.pallas_call`` summed against a configurable
+  per-core VMEM budget (``AIRLINT_VMEM_BUDGET_MIB``, default 16).
+
+The interpreter is deliberately unsound-but-useful: loops run their body
+once and join (differing dims widen to the anonymous top dim), branches
+join both arms, list mutation beyond ``append`` invalidates, and anything
+unrecognized evaluates to UNKNOWN — every check fires only on fully-known
+values, so imprecision always means silence, never a false alarm.  Pure
+stdlib; importing this module must never pull in jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..context import JIT_NAMES, dotted, jit_call_info, jit_decoration
+from .callgraph import CallGraph, ClassInfo, FunctionInfo, walk_scope
+from .lockset import RawFinding, _display
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+DOUBLE_BUFFER = 2  # Pallas pipelines blocks: each live tile is double-buffered
+DEFAULT_VMEM_MIB = 16
+JX007_DISTINCT_SIGS = 3  # concrete signatures at one jit target before firing
+
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+                "ppermute", "pshuffle", "psum_scatter", "axis_index"}
+_SHAPE_PRESERVING_COLLECTIVES = {"psum", "pmean", "pmax", "pmin"}
+_MAPPED_WRAPPERS = {"shard_map", "shard_map_unchecked", "pmap", "xmap"}
+
+_DTYPE_NAMES = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "bool_": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+_DTYPE_SHORT = {
+    "float64": "f64", "int64": "i64", "uint64": "u64", "complex64": "c64",
+    "float32": "f32", "int32": "i32", "uint32": "u32",
+    "bfloat16": "bf16", "float16": "f16", "int16": "i16", "uint16": "u16",
+    "int8": "i8", "uint8": "u8", "bool": "b1", "bool_": "b1",
+    "float8_e4m3fn": "f8e4m3", "float8_e5m2": "f8e5m2",
+}
+
+_ELEMENTWISE = {
+    "exp", "log", "log2", "sqrt", "rsqrt", "tanh", "abs", "negative", "sign",
+    "sin", "cos", "relu", "gelu", "sigmoid", "softplus", "square", "erf",
+    "logistic", "floor", "ceil", "round", "clip", "stop_gradient",
+}
+_BUILDERS = {"zeros", "ones", "empty", "full"}
+_LIKE_BUILDERS = {"zeros_like", "ones_like", "empty_like", "full_like"}
+
+
+# -- the abstract domain ------------------------------------------------------
+
+@dataclass(frozen=True)
+class Sym:
+    """A named symbolic dimension; ``varying`` marks loop-derived values."""
+
+    name: str
+    varying: bool = False
+
+
+ANYDIM = Sym("?")  # top of the dim lattice: join of two unequal dims
+
+
+class _Singleton:
+    def __init__(self, tag):
+        self.tag = tag
+
+    def __repr__(self):
+        return self.tag
+
+
+UNKNOWN = _Singleton("UNKNOWN")
+NONE = _Singleton("None")
+
+
+@dataclass(frozen=True)
+class IntVal:
+    value: object  # int | Sym
+
+
+@dataclass(frozen=True)
+class StrVal:
+    value: str
+
+
+@dataclass(frozen=True)
+class DtypeVal:
+    name: str
+
+
+@dataclass(frozen=True)
+class ArrayVal:
+    shape: Tuple[object, ...]  # of int | Sym
+    dtype: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class TupleVal:
+    elts: Tuple[object, ...]
+
+
+@dataclass(frozen=True)
+class SymVal:
+    """An opaque value with a provenance name (seeds function parameters)."""
+
+    name: str
+
+
+@dataclass(frozen=True)
+class MeshVal:
+    axes: Optional[Tuple[str, ...]]
+
+
+@dataclass(frozen=True)
+class SpecVal:
+    """A PartitionSpec: entries are str | None | tuple-of-str | UNKNOWN."""
+
+    entries: Tuple[object, ...]
+
+
+@dataclass(frozen=True)
+class ShardingVal:
+    mesh: object
+    spec: object
+
+
+@dataclass(frozen=True)
+class ModuleRef:
+    modname: str
+
+
+@dataclass(frozen=True)
+class ClassVal:
+    qname: str
+
+
+@dataclass(frozen=True)
+class InstanceVal:
+    cls_qname: str
+
+
+@dataclass
+class FuncVal:
+    """A function value: a module/class def or a nested def with closure."""
+
+    node: ast.AST  # FunctionDef | Lambda
+    ctx: object  # ModuleContext it was defined in
+    modname: str
+    display: str
+    closure: dict = field(default_factory=dict)
+    bound_self: object = None
+
+
+@dataclass
+class PartialVal:
+    func: object
+    args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+
+
+@dataclass
+class JitVal:
+    """The result of ``jax.jit(f, ...)`` or an ``@jit``-decorated def."""
+
+    func: object
+    donate: Tuple[int, ...]
+    static: Tuple[int, ...]
+    node: ast.AST
+    path: str
+    display: str
+
+
+@dataclass
+class MappedVal:
+    """The result of shard_map/pmap: calling it binds the axis context."""
+
+    func: object
+    axes: Optional[Tuple[str, ...]]
+
+
+@dataclass(frozen=True)
+class BlockSpecVal:
+    block: Optional[Tuple[object, ...]]
+
+
+@dataclass(frozen=True)
+class ScratchVal:
+    shape: Optional[Tuple[object, ...]]
+    dtype: Optional[str]
+
+
+@dataclass
+class PallasVal:
+    """A configured ``pl.pallas_call`` awaiting its operand call."""
+
+    node: ast.Call
+    path: str
+    grid: object = UNKNOWN
+    in_specs: object = UNKNOWN
+    out_specs: object = UNKNOWN
+    out_shape: object = UNKNOWN
+    scratch: object = UNKNOWN
+
+
+# -- rendering & joins --------------------------------------------------------
+
+def _dim_str(d) -> str:
+    if isinstance(d, Sym):
+        return ("~" if d.varying else "") + d.name
+    return str(d)
+
+
+def render(v) -> str:
+    """Stable human-readable rendering (also the memo/signature key)."""
+    if isinstance(v, ArrayVal):
+        dt = _DTYPE_SHORT.get(v.dtype, v.dtype or "?")
+        return f"{dt}[{','.join(_dim_str(d) for d in v.shape)}]"
+    if isinstance(v, IntVal):
+        return _dim_str(v.value)
+    if isinstance(v, StrVal):
+        return repr(v.value)
+    if isinstance(v, DtypeVal):
+        return _DTYPE_SHORT.get(v.name, v.name)
+    if isinstance(v, TupleVal):
+        return "(" + ", ".join(render(e) for e in v.elts) + ")"
+    if v is NONE:
+        return "None"
+    if isinstance(v, SymVal):
+        return v.name
+    if isinstance(v, SpecVal):
+        return "P(" + ", ".join(
+            "?" if e is UNKNOWN else repr(e) if isinstance(e, str)
+            else str(e) for e in v.entries) + ")"
+    if isinstance(v, MeshVal):
+        return "Mesh(" + ", ".join(v.axes or ("?",)) + ")"
+    if isinstance(v, ShardingVal):
+        return f"NamedSharding({render(v.mesh)}, {render(v.spec)})"
+    if isinstance(v, (FuncVal, JitVal)):
+        return f"<fn {v.display}>" if hasattr(v, "display") else "<fn>"
+    if isinstance(v, InstanceVal):
+        return f"<{v.cls_qname.rsplit('.', 1)[-1]}>"
+    return "?"
+
+
+def is_concrete(v) -> bool:
+    """Fully known: usable as a retrace-distinguishing signature part."""
+    if isinstance(v, ArrayVal):
+        return v.dtype is not None and all(
+            isinstance(d, int) for d in v.shape)
+    if isinstance(v, IntVal):
+        return isinstance(v.value, int)
+    if isinstance(v, (StrVal, DtypeVal)) or v is NONE:
+        return True
+    if isinstance(v, TupleVal):
+        return all(is_concrete(e) for e in v.elts)
+    return False
+
+
+def _has_varying(v) -> bool:
+    if isinstance(v, ArrayVal):
+        return any(isinstance(d, Sym) and d.varying for d in v.shape)
+    if isinstance(v, TupleVal):
+        return any(_has_varying(e) for e in v.elts)
+    return False
+
+
+def _varying_scalar(v) -> bool:
+    return isinstance(v, IntVal) and isinstance(v.value, Sym) \
+        and v.value.varying
+
+
+def join_dim(a, b):
+    if a == b:
+        return a
+    varying = (isinstance(a, Sym) and a.varying) or \
+        (isinstance(b, Sym) and b.varying)
+    return Sym("?", varying=varying) if varying else ANYDIM
+
+
+def join(a, b):
+    """Least upper bound of two abstract values."""
+    if a == b:
+        return a
+    if a is UNKNOWN or b is UNKNOWN:
+        return UNKNOWN
+    if isinstance(a, ArrayVal) and isinstance(b, ArrayVal) \
+            and len(a.shape) == len(b.shape):
+        return ArrayVal(
+            tuple(join_dim(x, y) for x, y in zip(a.shape, b.shape)),
+            a.dtype if a.dtype == b.dtype else None)
+    if isinstance(a, TupleVal) and isinstance(b, TupleVal) \
+            and len(a.elts) == len(b.elts):
+        return TupleVal(tuple(join(x, y) for x, y in zip(a.elts, b.elts)))
+    if isinstance(a, IntVal) and isinstance(b, IntVal):
+        return IntVal(join_dim(a.value, b.value))
+    return UNKNOWN
+
+
+def join_env(a: dict, b: dict) -> dict:
+    out = {}
+    for k in a:
+        if k in b:
+            out[k] = join(a[k], b[k])
+    return out
+
+
+def _as_dim(v):
+    """Coerce an abstract value to a dimension (int | Sym)."""
+    if isinstance(v, IntVal):
+        return v.value
+    if isinstance(v, SymVal):
+        return Sym(v.name)
+    return ANYDIM
+
+
+def _dims_from(v) -> Optional[Tuple[object, ...]]:
+    """A shape tuple from a TupleVal/IntVal of dims, else None."""
+    if isinstance(v, TupleVal):
+        return tuple(_as_dim(e) for e in v.elts)
+    if isinstance(v, (IntVal, SymVal)):
+        return (_as_dim(v),)
+    return None
+
+
+def _dtype_of(v) -> Optional[str]:
+    if isinstance(v, DtypeVal):
+        return v.name
+    if isinstance(v, StrVal) and v.value in _DTYPE_NAMES:
+        return v.value
+    return None
+
+
+def _loc(path: str, node: ast.AST) -> str:
+    return f"{os.path.basename(path)}:{getattr(node, 'lineno', 0)}"
+
+
+@dataclass
+class _Frame:
+    """One function evaluation: environment + dynamic context."""
+
+    ctx: object  # ModuleContext
+    modname: str
+    env: dict
+    chain: Tuple[str, ...]  # interprocedural witness chain
+    axis_env: Optional[Tuple[str, ...]] = None  # known mapped axes, or None
+    field_sink: Optional[dict] = None  # __init__ eval: records self.X = v
+    self_val: object = None
+    returns: list = field(default_factory=list)
+
+
+class ShapeAnalysis:
+    """Interprets every function with symbolic seeds and records rule events.
+
+    Entry points are evaluated in a deterministic order (module bodies,
+    then every function with parameters seeded as named symbols); callees
+    are additionally re-evaluated under each concrete argument signature
+    that reaches them, memoized per ``(function, signature, axis_env)``.
+    """
+
+    MAX_DEPTH = 8
+    FUEL = 1_500_000  # expression-evaluation budget for the whole run
+
+    def __init__(self, callgraph: CallGraph):
+        self.cg = callgraph
+        self.findings: List[RawFinding] = []
+        self._fuel = self.FUEL
+        self._memo: Dict[tuple, object] = {}
+        self._active: set = set()
+        self._module_envs: Dict[str, dict] = {}
+        self._mod_in_progress: set = set()
+        self._fields: Dict[str, dict] = {}
+        self._fields_in_progress: set = set()
+        self._class_by_qname = {ci.qname: ci
+                                for ci in self.cg.classes.values()}
+        self._gen_cache: Dict[int, bool] = {}
+        self._jit_sites: Dict[object, dict] = {}
+        self._seen: set = set()
+        try:
+            mib = int(os.environ.get("AIRLINT_VMEM_BUDGET_MIB",
+                                     str(DEFAULT_VMEM_MIB)))
+        except ValueError:
+            mib = DEFAULT_VMEM_MIB
+        self.vmem_budget = mib * (1 << 20)
+
+    # -- driver --------------------------------------------------------------
+    def run(self) -> None:
+        for modname in sorted(self.cg.modules):
+            self._module_env(modname)
+        for fn in self.cg.functions:
+            try:
+                self._eval_function(fn)
+            except Exception:  # abstract interpretation must never crash the lint run
+                pass
+        self._emit_storms()
+
+    def _eval_function(self, fn: FunctionInfo):
+        bound = InstanceVal(fn.cls.qname) if fn.cls is not None else None
+        if bound is not None:
+            self._class_fields(fn.cls)
+        args = []
+        info = jit_decoration(fn.node)
+        callee: object = FuncVal(fn.node, fn.ctx, fn.modname,
+                                 _display(fn), bound_self=bound)
+        if info is not None:
+            callee = JitVal(callee, info.donate, info.static, fn.node,
+                            fn.ctx.path, _display(fn))
+        return self._invoke(callee, fn.node, args, {},
+                            self._root_frame(fn.ctx, fn.modname))
+
+    def _root_frame(self, ctx, modname, chain=()):
+        return _Frame(ctx=ctx, modname=modname,
+                      env=dict(self._module_env(modname)), chain=chain)
+
+    # -- module-level state ---------------------------------------------------
+    def _module_env(self, modname: str) -> dict:
+        if modname in self._module_envs:
+            return self._module_envs[modname]
+        if modname in self._mod_in_progress:
+            return {}
+        self._mod_in_progress.add(modname)
+        env: dict = {}
+        ctx = self.cg.modules.get(modname)
+        if ctx is not None:
+            frame = _Frame(ctx=ctx, modname=modname, env=env, chain=())
+            try:
+                self._exec_block(ctx.tree.body, frame)
+            except Exception:  # abstract interpretation must never crash the lint run
+                pass
+        self._mod_in_progress.discard(modname)
+        self._module_envs[modname] = env
+        return env
+
+    def _class_fields(self, ci: ClassInfo) -> dict:
+        if ci.qname in self._fields:
+            return self._fields[ci.qname]
+        if ci.qname in self._fields_in_progress:
+            return {}
+        self._fields_in_progress.add(ci.qname)
+        sink: dict = {}
+        init = ci.methods.get("__init__")
+        if init is not None:
+            self_val = InstanceVal(ci.qname)
+            env = dict(self._module_env(ci.modname))
+            frame = _Frame(ctx=ci.ctx, modname=ci.modname, env=env, chain=(),
+                           field_sink=sink, self_val=self_val)
+            self._bind_params(init.node, [self_val], {}, frame)
+            try:
+                self._exec_block(init.node.body, frame)
+            except Exception:  # abstract interpretation must never crash the lint run
+                pass
+        self._fields_in_progress.discard(ci.qname)
+        self._fields[ci.qname] = sink
+        return sink
+
+    # -- statements -----------------------------------------------------------
+    def _exec_block(self, stmts, frame: _Frame) -> None:
+        for stmt in stmts:
+            self._exec(stmt, frame)
+
+    def _exec(self, stmt, frame: _Frame) -> None:
+        env = frame.env
+        if isinstance(stmt, ast.Assign):
+            val = self._eval(stmt.value, frame)
+            for tgt in stmt.targets:
+                self._assign(tgt, val, frame)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, self._eval(stmt.value, frame),
+                             frame)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                cur = env.get(stmt.target.id, UNKNOWN)
+                rhs = self._eval(stmt.value, frame)
+                env[stmt.target.id] = self._binop(type(stmt.op), cur, rhs)
+        elif isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, frame)
+        elif isinstance(stmt, ast.Return):
+            frame.returns.append(
+                NONE if stmt.value is None else self._eval(stmt.value, frame))
+        elif isinstance(stmt, ast.If):
+            self._eval(stmt.test, frame)
+            then_env = dict(env)
+            else_env = dict(env)
+            frame.env = then_env
+            self._exec_block(stmt.body, frame)
+            frame.env = else_env
+            self._exec_block(stmt.orelse, frame)
+            frame.env = join_env(then_env, else_env)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._exec_loop(stmt, frame)
+        elif isinstance(stmt, ast.While):
+            self._eval(stmt.test, frame)
+            body_env = dict(env)
+            frame.env = body_env
+            self._exec_block(stmt.body, frame)
+            frame.env = join_env(env, body_env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                val = self._eval(item.context_expr, frame)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, val, frame)
+            self._exec_block(stmt.body, frame)
+        elif isinstance(stmt, ast.Try):
+            pre = dict(env)
+            self._exec_block(stmt.body, frame)
+            merged = frame.env
+            for handler in stmt.handlers:
+                frame.env = dict(pre)
+                self._exec_block(handler.body, frame)
+                merged = join_env(merged, frame.env)
+            frame.env = merged
+            self._exec_block(stmt.orelse, frame)
+            self._exec_block(stmt.finalbody, frame)
+        elif isinstance(stmt, _FUNC_DEFS):
+            env[stmt.name] = self._make_closure(stmt, frame)
+        # everything else (Raise/Assert/Import/Global/Pass/Delete/ClassDef)
+        # has no effect on the abstract state we track
+
+    def _exec_loop(self, stmt, frame: _Frame) -> None:
+        it = self._eval(stmt.iter, frame)
+        target = stmt.target
+        loop_sym = None
+        if isinstance(target, ast.Name):
+            loop_sym = Sym(f"{target.id}@L{stmt.lineno}", varying=True)
+        elt: object = UNKNOWN
+        if isinstance(it, ArrayVal) and it.shape:
+            elt = ArrayVal(it.shape[1:], it.dtype)  # shape fixed per iter
+        elif isinstance(it, TupleVal) and it.elts:
+            elt = it.elts[0]
+            for e in it.elts[1:]:
+                elt = join(elt, e)
+        elif loop_sym is not None:
+            elt = IntVal(loop_sym)  # range()/unknown iterable: varying value
+        pre = dict(frame.env)
+        self._assign(target, elt, frame)
+        self._exec_block(stmt.body, frame)
+        frame.env = join_env(pre, frame.env)
+        self._exec_block(stmt.orelse, frame)
+
+    def _assign(self, target, val, frame: _Frame) -> None:
+        if isinstance(target, ast.Name):
+            frame.env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            self._unpack(target.elts, val, frame)
+        elif isinstance(target, ast.Attribute):
+            obj = self._eval(target.value, frame)
+            if frame.field_sink is not None and obj == frame.self_val:
+                frame.field_sink.setdefault(target.attr, val)
+        # subscript stores don't update our immutable abstractions
+
+    def _unpack(self, targets, val, frame: _Frame) -> None:
+        if any(isinstance(t, ast.Starred) for t in targets):
+            for t in targets:
+                self._assign(t.value if isinstance(t, ast.Starred) else t,
+                             UNKNOWN, frame)
+            return
+        if isinstance(val, TupleVal) and len(val.elts) == len(targets):
+            for t, v in zip(targets, val.elts):
+                self._assign(t, v, frame)
+            return
+        if isinstance(val, ArrayVal) and val.shape \
+                and isinstance(val.shape[0], int) \
+                and val.shape[0] == len(targets):
+            for t in targets:
+                self._assign(t, ArrayVal(val.shape[1:], val.dtype), frame)
+            return
+        if isinstance(val, SymVal):
+            for i, t in enumerate(targets):
+                self._assign(t, SymVal(f"{val.name}[{i}]"), frame)
+            return
+        for t in targets:
+            self._assign(t, UNKNOWN, frame)
+
+    def _make_closure(self, node, frame: _Frame):
+        name = getattr(node, "name", "<lambda>")
+        return FuncVal(node, frame.ctx, frame.modname, name,
+                       closure=dict(frame.env), bound_self=None)
+
+    # -- expressions ----------------------------------------------------------
+    def _eval(self, node, frame: _Frame):
+        if self._fuel <= 0:
+            return UNKNOWN
+        self._fuel -= 1
+        try:
+            return self._eval_inner(node, frame)
+        except RecursionError:
+            raise
+        except Exception:  # any evaluation hole must degrade to UNKNOWN, not crash
+            return UNKNOWN
+
+    def _eval_inner(self, node, frame: _Frame):
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if v is None:
+                return NONE
+            if isinstance(v, bool):
+                return UNKNOWN
+            if isinstance(v, int):
+                return IntVal(v)
+            if isinstance(v, str):
+                return StrVal(v)
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return self._lookup(node.id, frame)
+        if isinstance(node, ast.Attribute):
+            return self._attribute(node, frame)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return TupleVal(tuple(self._eval(e, frame) for e in node.elts))
+        if isinstance(node, ast.BinOp):
+            return self._binop(type(node.op),
+                               self._eval(node.left, frame),
+                               self._eval(node.right, frame))
+        if isinstance(node, ast.UnaryOp):
+            v = self._eval(node.operand, frame)
+            if isinstance(node.op, ast.USub) and isinstance(v, IntVal) \
+                    and isinstance(v.value, int):
+                return IntVal(-v.value)
+            return v if isinstance(v, ArrayVal) else UNKNOWN
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node, frame)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, frame)
+        if isinstance(node, ast.Lambda):
+            return self._make_closure(node, frame)
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, frame)
+            return join(self._eval(node.body, frame),
+                        self._eval(node.orelse, frame))
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.expr):
+                    self._eval(sub, frame)
+            return UNKNOWN
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, frame)
+        return UNKNOWN
+
+    def _lookup(self, name: str, frame: _Frame):
+        if name in frame.env:
+            return frame.env[name]
+        return self._entity(frame.modname, name)
+
+    def _entity(self, modname: str, name: str):
+        """Resolve a module-scope name: defs, classes, module-level
+        assignments (evaluated), and imported values."""
+        if modname in self.cg.modules:
+            menv = self._module_env(modname)
+            if name in menv:
+                return menv[name]
+        ent = self.cg._resolve_in_module(modname, name)
+        if ent is not None:
+            kind, val = ent
+            if kind == "func":
+                return self._func_value(val)
+            if kind == "class":
+                return ClassVal(val.qname)
+            if kind == "instance":
+                return InstanceVal(val.qname)
+            if kind == "module":
+                return ModuleRef(val)
+        bound = self.cg.imports.get(modname, {}).get(name)
+        if bound is not None:
+            base, attr = bound
+            if attr is not None and base in self.cg.modules:
+                imported = self._module_env(base).get(attr)
+                if imported is not None:
+                    return imported
+        return UNKNOWN
+
+    def _func_value(self, fi: FunctionInfo):
+        fv = FuncVal(fi.node, fi.ctx, fi.modname, _display(fi))
+        info = jit_decoration(fi.node)
+        if info is not None:
+            return JitVal(fv, info.donate, info.static, fi.node,
+                          fi.ctx.path, _display(fi))
+        return fv
+
+    def _canonical(self, modname: str, name: str) -> str:
+        """Alias-resolve the first component through the import table."""
+        parts = name.split(".")
+        bound = self.cg.imports.get(modname, {}).get(parts[0])
+        if bound is None:
+            return name
+        base, attr = bound
+        prefix = base if attr is None else f"{base}.{attr}"
+        return ".".join([prefix] + parts[1:])
+
+    def _attribute(self, node: ast.Attribute, frame: _Frame):
+        full = dotted(node)
+        if full is not None:
+            head = full.split(".", 1)[0]
+            if head not in frame.env:
+                canon = self._canonical(frame.modname, full)
+                last = canon.rsplit(".", 1)[-1]
+                if last in _DTYPE_NAMES and (
+                        "numpy" in canon or canon.startswith("jax.")):
+                    return DtypeVal(last)
+        obj = self._eval(node.value, frame)
+        attr = node.attr
+        if isinstance(obj, ArrayVal):
+            if attr == "shape":
+                return TupleVal(tuple(IntVal(d) for d in obj.shape))
+            if attr == "dtype":
+                return DtypeVal(obj.dtype) if obj.dtype else UNKNOWN
+            if attr == "ndim":
+                return IntVal(len(obj.shape))
+            if attr == "T":
+                return ArrayVal(tuple(reversed(obj.shape)), obj.dtype)
+            if attr == "size":
+                n = 1
+                for d in obj.shape:
+                    if not isinstance(d, int):
+                        return IntVal(Sym("size"))
+                    n *= d
+                return IntVal(n)
+            return UNKNOWN
+        if isinstance(obj, SymVal):
+            return SymVal(f"{obj.name}.{attr}")
+        if isinstance(obj, ModuleRef):
+            return self._entity(obj.modname, attr)
+        if isinstance(obj, InstanceVal):
+            ci = self._class_by_qname.get(obj.cls_qname)
+            if ci is None:
+                return UNKNOWN
+            if frame.field_sink is not None and obj == frame.self_val \
+                    and attr in frame.field_sink:
+                return frame.field_sink[attr]
+            fields = self._class_fields(ci)
+            if attr in fields:
+                return fields[attr]
+            m = self.cg.lookup_method(ci, attr)
+            if m is not None:
+                mv = self._func_value(m)
+                if isinstance(mv, FuncVal):
+                    mv.bound_self = obj
+                elif isinstance(mv, JitVal) and isinstance(mv.func, FuncVal):
+                    mv.func.bound_self = obj
+                return mv
+            return UNKNOWN
+        if isinstance(obj, MeshVal) and attr == "axis_names" and obj.axes:
+            return TupleVal(tuple(StrVal(a) for a in obj.axes))
+        return UNKNOWN
+
+    def _binop(self, op, a, b):
+        if isinstance(a, IntVal) and isinstance(b, IntVal):
+            return IntVal(_dim_arith(op, a.value, b.value))
+        if isinstance(a, TupleVal) and isinstance(b, TupleVal) \
+                and op is ast.Add:
+            return TupleVal(a.elts + b.elts)
+        if isinstance(a, TupleVal) and isinstance(b, IntVal) \
+                and op is ast.Mult and isinstance(b.value, int) \
+                and 0 <= b.value <= 16:
+            return TupleVal(a.elts * b.value)
+        if isinstance(a, StrVal) and isinstance(b, StrVal) and op is ast.Add:
+            return StrVal(a.value + b.value)
+        if isinstance(a, ArrayVal) or isinstance(b, ArrayVal):
+            return self._array_binop(a, b)
+        return UNKNOWN
+
+    def _array_binop(self, a, b):
+        if isinstance(a, ArrayVal) and isinstance(b, ArrayVal):
+            return _broadcast(a, b)
+        arr = a if isinstance(a, ArrayVal) else b
+        other = b if arr is a else a
+        if isinstance(other, (IntVal, SymVal)) or other is UNKNOWN:
+            return arr
+        return UNKNOWN
+
+    def _subscript(self, node: ast.Subscript, frame: _Frame):
+        obj = self._eval(node.value, frame)
+        idx = node.slice
+        if isinstance(obj, TupleVal):
+            if isinstance(idx, ast.Constant) and isinstance(idx.value, int):
+                i = idx.value
+                if -len(obj.elts) <= i < len(obj.elts):
+                    return obj.elts[i]
+                return UNKNOWN
+            iv = self._eval(idx, frame)
+            if isinstance(iv, IntVal) and isinstance(iv.value, int) \
+                    and -len(obj.elts) <= iv.value < len(obj.elts):
+                return obj.elts[iv.value]
+            if isinstance(idx, ast.Slice):
+                lo, hi = _const_slice(idx)
+                if lo is not None:
+                    return TupleVal(obj.elts[lo:hi])
+            return UNKNOWN
+        if isinstance(obj, ArrayVal):
+            return self._index_array(obj, idx, frame)
+        if isinstance(obj, SymVal):
+            return SymVal(f"{obj.name}[…]")
+        return UNKNOWN
+
+    def _index_array(self, arr: ArrayVal, idx, frame: _Frame):
+        items = idx.elts if isinstance(idx, ast.Tuple) else [idx]
+        out: List[object] = []
+        pos = 0
+        for item in items:
+            if isinstance(item, ast.Constant) and item.value is None:
+                out.append(1)
+                continue
+            if pos >= len(arr.shape):
+                return UNKNOWN
+            dim = arr.shape[pos]
+            if isinstance(item, ast.Slice):
+                d = _slice_dim(dim, item, frame, self)
+                if d is None:
+                    return UNKNOWN
+                out.append(d)
+                pos += 1
+                continue
+            iv = self._eval(item, frame)
+            if isinstance(iv, (IntVal, SymVal)):
+                pos += 1  # integer index: drops the dim
+                continue
+            if isinstance(iv, ArrayVal):  # fancy index: dim(s) of the index
+                out.extend(iv.shape)
+                pos += 1
+                continue
+            return UNKNOWN
+        out.extend(arr.shape[pos:])
+        return ArrayVal(tuple(out), arr.dtype)
+
+    # -- calls ----------------------------------------------------------------
+    def _eval_call(self, call: ast.Call, frame: _Frame):
+        name = dotted(call.func)
+        if name is not None and name.split(".", 1)[0] not in frame.env:
+            special = self._special_call(name, call, frame)
+            if special is not None:
+                return special
+        func = self._eval(call.func, frame)
+        args = [self._eval(a, frame) for a in call.args
+                if not isinstance(a, ast.Starred)]
+        if any(isinstance(a, ast.Starred) for a in call.args):
+            args = None  # positional binding unknowable
+        kwargs = {kw.arg: self._eval(kw.value, frame)
+                  for kw in call.keywords if kw.arg is not None}
+        if isinstance(call.func, ast.Attribute) and func is UNKNOWN:
+            return self._method_like(call, args, frame)
+        return self._invoke(func, call, args, kwargs, frame)
+
+    def _method_like(self, call: ast.Call, args, frame: _Frame):
+        """Method calls on tracked values (reshape/astype/append/…)."""
+        obj = self._eval(call.func.value, frame)
+        attr = call.func.attr
+        if isinstance(obj, ArrayVal):
+            if attr == "reshape" and args is not None:
+                flat = args[0] if len(args) == 1 \
+                    and isinstance(args[0], TupleVal) else TupleVal(tuple(args))
+                dims = _dims_from(flat)
+                return ArrayVal(dims, obj.dtype) if dims else UNKNOWN
+            if attr == "astype" and args:
+                dt = _dtype_of(args[0])
+                return ArrayVal(obj.shape, dt or obj.dtype)
+            if attr in ("copy", "block_until_ready"):
+                return obj
+            if attr in ("sum", "mean", "max", "min"):
+                return self._reduce(obj, call, frame)
+            if attr == "transpose":
+                return ArrayVal(tuple(reversed(obj.shape)), obj.dtype) \
+                    if not args else UNKNOWN
+        if isinstance(obj, TupleVal) and attr == "append" \
+                and isinstance(call.func.value, ast.Name) and args \
+                and len(args) == 1:
+            frame.env[call.func.value.id] = TupleVal(obj.elts + (args[0],))
+            return NONE
+        return UNKNOWN
+
+    def _special_call(self, name: str, call: ast.Call, frame: _Frame):
+        """Recognized external constructors/ops.  None = not special."""
+        canon = self._canonical(frame.modname, name)
+        last = canon.rsplit(".", 1)[-1]
+        info = jit_call_info(call)
+        if info is not None and (canon in JIT_NAMES or name in JIT_NAMES):
+            inner = self._eval(call.args[0], frame) if call.args else UNKNOWN
+            for kw in call.keywords:
+                if kw.arg in ("in_shardings", "out_shardings"):
+                    self._eval(kw.value, frame)  # runs the JX008 checks
+            display = dotted(call.args[0]) if call.args else None
+            return JitVal(inner, info.donate, info.static, call,
+                          frame.ctx.path, display or "<jit>")
+        if last == "PartitionSpec" and ("sharding" in canon
+                                        or name in ("P", "PartitionSpec")):
+            return self._make_spec(call, frame)
+        if last == "NamedSharding" or last == "Mesh":
+            return self._make_sharding(last, call, frame)
+        if last in _MAPPED_WRAPPERS:
+            return self._make_mapped(call, frame)
+        if last in _COLLECTIVES and ("jax" in canon or canon == last):
+            return self._collective(last, call, frame)
+        if last == "ShapeDtypeStruct":
+            shape = _dims_from(self._eval(call.args[0], frame)) \
+                if call.args else None
+            dt = _dtype_of(self._eval(call.args[1], frame)) \
+                if len(call.args) > 1 else None
+            return ArrayVal(shape, dt) if shape is not None else UNKNOWN
+        if last == "BlockSpec":
+            block = None
+            if call.args:
+                block = _dims_from(self._eval(call.args[0], frame))
+            for kw in call.keywords:
+                if kw.arg == "block_shape":
+                    block = _dims_from(self._eval(kw.value, frame))
+            return BlockSpecVal(tuple(block) if block else None)
+        if last in ("VMEM", "SMEM", "ANY") and "pallas" in canon:
+            shape = _dims_from(self._eval(call.args[0], frame)) \
+                if call.args else None
+            dt = _dtype_of(self._eval(call.args[1], frame)) \
+                if len(call.args) > 1 else None
+            return ScratchVal(shape, dt)
+        if last == "pallas_call":
+            return self._make_pallas(call, frame)
+        if last == "device_put":
+            val = self._eval(call.args[0], frame) if call.args else UNKNOWN
+            if len(call.args) > 1:
+                self._eval(call.args[1], frame)  # runs the JX008 checks
+            return val
+        if canon in ("functools.partial", "partial"):
+            if not call.args:
+                return UNKNOWN
+            return PartialVal(
+                self._eval(call.args[0], frame),
+                tuple(self._eval(a, frame) for a in call.args[1:]),
+                {kw.arg: self._eval(kw.value, frame)
+                 for kw in call.keywords if kw.arg})
+        numpy_like = canon.startswith(("jax.numpy.", "numpy.", "jax.nn.",
+                                       "jax.lax.", "jax.random."))
+        if numpy_like:
+            return self._numpy_call(last, call, frame)
+        if canon in ("len", "range", "tuple", "list", "int", "float",
+                     "print", "isinstance", "min", "max", "sum"):
+            return self._builtin(canon, call, frame)
+        return None
+
+    def _numpy_call(self, last: str, call: ast.Call, frame: _Frame):
+        args = [self._eval(a, frame) for a in call.args]
+        kwargs = {kw.arg: self._eval(kw.value, frame)
+                  for kw in call.keywords if kw.arg}
+        if last in _BUILDERS or last in _LIKE_BUILDERS \
+                or last in ("normal", "uniform"):
+            return _build_array(last, args, kwargs)
+        if last in _ELEMENTWISE and args:
+            a = args[0]
+            return a if isinstance(a, ArrayVal) else UNKNOWN
+        if last in ("add", "subtract", "multiply", "divide", "maximum",
+                    "minimum", "where", "power"):
+            arrs = [a for a in args if isinstance(a, ArrayVal)]
+            if len(arrs) >= 2:
+                return _broadcast(arrs[-2], arrs[-1])
+            return arrs[0] if arrs else UNKNOWN
+        if last == "astype" and args:
+            return args[0]
+        if last == "asarray" and args and isinstance(args[0], ArrayVal):
+            return args[0]
+        if last == "arange":
+            ints = [a for a in args if isinstance(a, (IntVal, SymVal))]
+            if len(ints) == 1:
+                return ArrayVal((_as_dim(ints[0]),),
+                                _dtype_of(kwargs.get("dtype", UNKNOWN))
+                                or "int32")
+            return UNKNOWN
+        if last == "reshape" and len(args) >= 2 \
+                and isinstance(args[0], ArrayVal):
+            dims = _dims_from(args[1])
+            return ArrayVal(dims, args[0].dtype) if dims else UNKNOWN
+        if last in ("sum", "mean", "max", "min", "prod") and args \
+                and isinstance(args[0], ArrayVal):
+            return self._reduce(args[0], call, frame, skip_first=True)
+        if last in ("dot", "matmul") and len(args) >= 2 \
+                and isinstance(args[0], ArrayVal) \
+                and isinstance(args[1], ArrayVal):
+            a, b = args[0], args[1]
+            if len(a.shape) >= 1 and len(b.shape) >= 2:
+                return ArrayVal(a.shape[:-1] + b.shape[:-2] + b.shape[-1:],
+                                a.dtype if a.dtype == b.dtype else None)
+        return UNKNOWN
+
+    def _reduce(self, arr: ArrayVal, call: ast.Call, frame: _Frame,
+                skip_first: bool = False):
+        axis = None
+        keep = False
+        for kw in call.keywords:
+            if kw.arg == "axis":
+                axis = self._eval(kw.value, frame)
+            elif kw.arg == "keepdims":
+                keep = isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is True
+        pos = call.args[1:] if skip_first else call.args
+        if axis is None and pos:
+            axis = self._eval(pos[0], frame)
+        if axis is None:
+            return ArrayVal((), arr.dtype)
+        if isinstance(axis, IntVal) and isinstance(axis.value, int):
+            i = axis.value
+            if -len(arr.shape) <= i < len(arr.shape):
+                i %= len(arr.shape)
+                shape = list(arr.shape)
+                if keep:
+                    shape[i] = 1
+                else:
+                    del shape[i]
+                return ArrayVal(tuple(shape), arr.dtype)
+        return UNKNOWN
+
+    def _builtin(self, canon: str, call: ast.Call, frame: _Frame):
+        args = [self._eval(a, frame) for a in call.args]
+        if canon == "len" and args:
+            a = args[0]
+            if isinstance(a, TupleVal):
+                return IntVal(len(a.elts))
+            if isinstance(a, ArrayVal) and a.shape:
+                return IntVal(a.shape[0])
+            return UNKNOWN
+        if canon in ("tuple", "list"):
+            if not args:
+                return TupleVal(())
+            return args[0] if isinstance(args[0], TupleVal) else UNKNOWN
+        if canon == "int" and args and isinstance(args[0], IntVal):
+            return args[0]
+        if canon == "range":
+            return UNKNOWN  # only meaningful as a For iterable
+        return UNKNOWN
+
+    # -- value invocation -----------------------------------------------------
+    def _invoke(self, func, call, args, kwargs, frame: _Frame):
+        if args is None:
+            args = []
+        if isinstance(func, PartialVal):
+            merged_kw = dict(func.kwargs)
+            merged_kw.update(kwargs)
+            return self._invoke(func.func, call, list(func.args) + list(args),
+                                merged_kw, frame)
+        if isinstance(func, JitVal):
+            self._record_jit_call(func, call, args, frame)
+            result = self._invoke(func.func, call, args, kwargs, frame)
+            self._check_donation(func, call, args, result, frame)
+            return result
+        if isinstance(func, MappedVal):
+            inner = _Frame(ctx=frame.ctx, modname=frame.modname,
+                           env=frame.env, chain=frame.chain,
+                           axis_env=func.axes or frame.axis_env,
+                           returns=frame.returns)
+            return self._invoke(func.func, call, args, kwargs, inner)
+        if isinstance(func, FuncVal):
+            return self._call_func(func, call, args, kwargs, frame)
+        if isinstance(func, ClassVal):
+            ci = self._class_by_qname.get(func.qname)
+            if ci is not None:
+                self._class_fields(ci)
+            return InstanceVal(func.qname)
+        if isinstance(func, PallasVal):
+            return self._check_pallas(func, call, args, frame)
+        return UNKNOWN
+
+    def _call_func(self, fv: FuncVal, call, args, kwargs, frame: _Frame):
+        if len(frame.chain) >= self.MAX_DEPTH:
+            return UNKNOWN
+        if isinstance(fv.node, ast.Lambda):
+            inner = self._child_frame(fv, call, args, kwargs, frame)
+            return self._eval(fv.node.body, inner)
+        sig = (id(fv.node), frame.axis_env,
+               tuple(render(a) for a in args),
+               tuple(sorted((k, render(v)) for k, v in kwargs.items())),
+               render(fv.bound_self) if fv.bound_self else "")
+        if sig in self._memo:
+            return self._memo[sig]
+        if sig in self._active:
+            return UNKNOWN
+        self._active.add(sig)
+        inner = self._child_frame(fv, call, args, kwargs, frame)
+        if self._is_generator(fv.node):
+            result = UNKNOWN
+        else:
+            self._exec_block(fv.node.body, inner)
+            result = NONE
+            for r in inner.returns:
+                result = r if result is NONE else join(result, r)
+        self._active.discard(sig)
+        self._memo[sig] = result
+        return result
+
+    def _child_frame(self, fv: FuncVal, call, args, kwargs,
+                     frame: _Frame) -> _Frame:
+        env = dict(self._module_env(fv.modname))
+        env.update(fv.closure)
+        link = f"{fv.display} ({_loc(fv.ctx.path, fv.node)})"
+        inner = _Frame(ctx=fv.ctx, modname=fv.modname, env=env,
+                       chain=frame.chain + (link,), axis_env=frame.axis_env)
+        all_args = ([fv.bound_self] if fv.bound_self is not None else []) \
+            + list(args)
+        self._bind_params(fv.node, all_args, kwargs, inner)
+        return inner
+
+    def _bind_params(self, node, args, kwargs, frame: _Frame) -> None:
+        a = node.args
+        params = list(a.posonlyargs) + list(a.args)
+        defaults = list(a.defaults)
+        # rightmost defaults align with rightmost params
+        default_by_name = {}
+        for p, d in zip(params[len(params) - len(defaults):], defaults):
+            default_by_name[p.arg] = d
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                default_by_name[p.arg] = d
+        for i, p in enumerate(params + list(a.kwonlyargs)):
+            if i < len(args) and p in params:
+                frame.env[p.arg] = args[i]
+            elif p.arg in kwargs:
+                frame.env[p.arg] = kwargs[p.arg]
+            elif p.arg in default_by_name:
+                val = self._eval(default_by_name[p.arg], frame)
+                frame.env[p.arg] = val if val is not UNKNOWN \
+                    else SymVal(p.arg)
+            else:
+                frame.env[p.arg] = SymVal(p.arg)
+        if a.vararg is not None:
+            rest = args[len(params):]
+            frame.env[a.vararg.arg] = TupleVal(tuple(rest))
+
+    # -- constructors with JX008 checks ---------------------------------------
+    def _make_spec(self, call: ast.Call, frame: _Frame) -> SpecVal:
+        entries = []
+        for arg in call.args:
+            v = self._eval(arg, frame)
+            if isinstance(v, StrVal):
+                entries.append(v.value)
+            elif v is NONE:
+                entries.append(None)
+            elif isinstance(v, TupleVal) and all(
+                    isinstance(e, StrVal) for e in v.elts):
+                entries.append(tuple(e.value for e in v.elts))
+            else:
+                entries.append(UNKNOWN)
+        return SpecVal(tuple(entries))
+
+    def _make_sharding(self, last: str, call: ast.Call, frame: _Frame):
+        args = [self._eval(a, frame) for a in call.args]
+        kwargs = {kw.arg: self._eval(kw.value, frame)
+                  for kw in call.keywords if kw.arg}
+        if last == "Mesh":
+            axes = args[1] if len(args) > 1 else kwargs.get("axis_names")
+            names = _axis_tuple(axes)
+            return MeshVal(names)
+        mesh = args[0] if args else kwargs.get("mesh", UNKNOWN)
+        spec = args[1] if len(args) > 1 else kwargs.get("spec", UNKNOWN)
+        self._check_spec_axes(mesh, spec, call, frame, what="NamedSharding")
+        return ShardingVal(mesh, spec)
+
+    def _make_mapped(self, call: ast.Call, frame: _Frame) -> MappedVal:
+        fn = self._eval(call.args[0], frame) if call.args else UNKNOWN
+        kwargs = {kw.arg: self._eval(kw.value, frame)
+                  for kw in call.keywords if kw.arg}
+        mesh = kwargs.get("mesh", UNKNOWN)
+        if len(call.args) > 1 and mesh is UNKNOWN:
+            mesh = self._eval(call.args[1], frame)
+        axes = mesh.axes if isinstance(mesh, MeshVal) else None
+        axis_name = kwargs.get("axis_name")
+        if axes is None and isinstance(axis_name, StrVal):
+            axes = (axis_name.value,)  # pmap binds a single named axis
+        for key in ("in_specs", "out_specs"):
+            specs = kwargs.get(key)
+            if specs is None:
+                continue
+            for spec in (specs.elts if isinstance(specs, TupleVal)
+                         else (specs,)):
+                self._check_spec_axes(mesh, spec, call, frame,
+                                      what=f"shard_map {key}")
+        return MappedVal(fn, axes)
+
+    def _check_spec_axes(self, mesh, spec, node, frame: _Frame,
+                         what: str) -> None:
+        if not isinstance(mesh, MeshVal) or mesh.axes is None \
+                or not isinstance(spec, SpecVal):
+            return
+        used = []
+        for e in spec.entries:
+            if isinstance(e, str):
+                used.append(e)
+            elif isinstance(e, tuple):
+                used.extend(e)
+        for axis in used:
+            if axis not in mesh.axes:
+                self._emit(
+                    "JX008", frame.ctx.path, node,
+                    f"{what} uses axis {axis!r} but the mesh only has axes "
+                    f"({', '.join(mesh.axes)}) — this raises at trace time "
+                    "on hardware, or silently no-ops under a stand-in mesh",
+                    {"mesh_axes": list(mesh.axes),
+                     "spec": render(spec),
+                     "call_path": list(frame.chain)},
+                    key=("ax", frame.ctx.path, node.lineno, axis))
+
+    def _collective(self, last: str, call: ast.Call, frame: _Frame):
+        args = [self._eval(a, frame) for a in call.args]
+        kwargs = {kw.arg: self._eval(kw.value, frame)
+                  for kw in call.keywords if kw.arg}
+        axis = kwargs.get("axis_name")
+        if axis is None:
+            pos = 0 if last == "axis_index" else 1
+            if len(args) > pos:
+                axis = args[pos]
+        names = _axis_tuple(axis) or ()
+        if names and frame.axis_env is not None:
+            for ax in names:
+                if ax not in frame.axis_env:
+                    self._emit(
+                        "JX008", frame.ctx.path, call,
+                        f"collective {last!r} names axis {ax!r} but the "
+                        "enclosing shard_map/pmap only binds "
+                        f"({', '.join(frame.axis_env)}) — unbound axis "
+                        "names fail at trace time",
+                        {"axis_env": list(frame.axis_env),
+                         "axis": ax,
+                         "call_path": list(frame.chain)},
+                        key=("coll", frame.ctx.path, call.lineno, ax))
+        if last in _SHAPE_PRESERVING_COLLECTIVES and args:
+            a = args[0]
+            if isinstance(a, ArrayVal):
+                return a
+            if isinstance(a, IntVal):
+                return IntVal(Sym(f"{last}()"))
+        if last == "axis_index":
+            return IntVal(Sym("axis_index()"))
+        return UNKNOWN
+
+    def _make_pallas(self, call: ast.Call, frame: _Frame) -> PallasVal:
+        pv = PallasVal(call, frame.ctx.path)
+        kwargs = {kw.arg: self._eval(kw.value, frame)
+                  for kw in call.keywords if kw.arg}
+        pv.grid = kwargs.get("grid", UNKNOWN)
+        pv.in_specs = kwargs.get("in_specs", UNKNOWN)
+        pv.out_specs = kwargs.get("out_specs", UNKNOWN)
+        pv.out_shape = kwargs.get("out_shape", UNKNOWN)
+        pv.scratch = kwargs.get("scratch_shapes", UNKNOWN)
+        return pv
+
+    # -- rule events ----------------------------------------------------------
+    def _emit(self, rule: str, path: str, node, message: str,
+              dataflow: dict, key) -> None:
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(RawFinding(rule, path, node, message, dataflow))
+
+    def _record_jit_call(self, jv: JitVal, call, args, frame: _Frame) -> None:
+        target = id(jv.node)
+        rec = self._jit_sites.setdefault(target, {
+            "display": jv.display, "decl_path": jv.path,
+            "decl": jv.node, "sigs": {}})
+        sig = tuple(render(a) for a in args)
+        site = (frame.ctx.path, getattr(call, "lineno", 0))
+        rec["sigs"].setdefault(sig, {
+            "concrete": all(is_concrete(a) for a in args) and bool(args),
+            "path": frame.ctx.path, "node": call,
+            "chain": list(frame.chain)})
+        varying_args = [i for i, a in enumerate(args) if _has_varying(a)]
+        static_varying = [i for i in jv.static
+                          if i < len(args) and _varying_scalar(args[i])]
+        if varying_args or static_varying:
+            if varying_args:
+                i = varying_args[0]
+                detail = (f"argument {i} has a loop-varying shape "
+                          f"{render(args[i])}")
+            else:
+                i = static_varying[0]
+                detail = (f"static argnum {i} receives a loop-varying value "
+                          f"{render(args[i])} — every value is a new cache "
+                          "key")
+            self._emit(
+                "JX007", frame.ctx.path, call,
+                f"jit function {jv.display!r} retraces on every loop "
+                f"iteration: {detail}; hoist the jit or pad/bucket the "
+                "varying dimension",
+                {"jit": jv.display, "signature": list(sig),
+                 "varying_args": varying_args or static_varying,
+                 "call_path": list(frame.chain)},
+                key=("jx7v", frame.ctx.path, getattr(call, "lineno", 0)))
+
+    def _emit_storms(self) -> None:
+        for rec in self._jit_sites.values():
+            concrete = {sig: info for sig, info in rec["sigs"].items()
+                        if info["concrete"]}
+            if len(concrete) < JX007_DISTINCT_SIGS:
+                continue
+            sites = sorted({(i["path"], i["node"].lineno)
+                            for i in concrete.values()})
+            evidence = [{"args": list(sig), "site": f"{p}:{ln}",
+                         "call_path": info["chain"]}
+                        for sig, info in sorted(concrete.items())
+                        for p, ln in [(info["path"], info["node"].lineno)]]
+            first = min(concrete.values(),
+                        key=lambda i: (i["path"], i["node"].lineno))
+            self._emit(
+                "JX007", rec["decl_path"], rec["decl"],
+                f"jit function {rec['display']!r} is reached by "
+                f"{len(concrete)} distinct concrete shape signatures "
+                f"({', '.join('(' + ', '.join(s) + ')' for s in sorted(concrete))}) "
+                f"from {len(sites)} callsite(s) — each one is a separate "
+                "XLA compilation; pad/bucket the inputs or split the entry "
+                "points",
+                {"jit": rec["display"], "signatures": evidence,
+                 "first_site": f"{first['path']}:{first['node'].lineno}"},
+                key=("jx7s", id(rec["decl"])))
+
+    def _check_donation(self, jv: JitVal, call, args, result,
+                        frame: _Frame) -> None:
+        if not jv.donate or not args:
+            return
+        outs = _flatten_arrays(result)
+        if outs is None:
+            return
+        out_sigs = {(o.shape, o.dtype) for o in outs}
+        for i in jv.donate:
+            if i >= len(args):
+                continue
+            a = args[i]
+            if not isinstance(a, ArrayVal) or not is_concrete(a):
+                continue
+            if (a.shape, a.dtype) in out_sigs:
+                continue
+            self._emit(
+                "JX009", frame.ctx.path, call,
+                f"donated argument {i} of jitted {jv.display!r} is "
+                f"{render(a)} but no output matches that shape/dtype "
+                f"(outputs: {', '.join(render(o) for o in outs) or 'none'})"
+                " — XLA silently drops the donation and both buffers stay "
+                "live in HBM",
+                {"jit": jv.display, "argnum": i, "donated": render(a),
+                 "outputs": [render(o) for o in outs],
+                 "call_path": list(frame.chain)},
+                key=("jx9", frame.ctx.path, getattr(call, "lineno", 0), i))
+
+    def _check_pallas(self, pv: PallasVal, call, args, frame: _Frame):
+        parts = []  # (label, block_dims, dtype, bytes, buffered)
+        ok = self._tile_parts(pv, args, parts)
+        if ok:
+            total = sum(p[3] * (DOUBLE_BUFFER if p[4] else 1) for p in parts)
+            if total > self.vmem_budget:
+                detail = "; ".join(
+                    f"{label} {render(ArrayVal(block, dt))}="
+                    f"{nbytes * (DOUBLE_BUFFER if buf else 1)}B"
+                    for label, block, dt, nbytes, buf in parts)
+                self._emit(
+                    "PL001", pv.path, pv.node,
+                    f"pallas_call tiles need {total} bytes of VMEM "
+                    f"(double-buffered blocks + scratch: {detail}) but the "
+                    f"per-core budget is {self.vmem_budget} bytes — shrink "
+                    "the BlockSpec tiles or spill scratch "
+                    "(AIRLINT_VMEM_BUDGET_MIB overrides the budget)",
+                    {"total_bytes": total,
+                     "budget_bytes": self.vmem_budget,
+                     "tiles": [
+                         {"role": label,
+                          "block": [_dim_str(d) for d in block],
+                          "dtype": dt or "assumed-f32", "bytes": nbytes,
+                          "double_buffered": buf}
+                         for label, block, dt, nbytes, buf in parts],
+                     "call_path": list(frame.chain)},
+                    key=("pl1", pv.path, pv.node.lineno))
+        return pv.out_shape if pv.out_shape is not UNKNOWN else UNKNOWN
+
+    def _tile_parts(self, pv: PallasVal, args, parts: list) -> bool:
+        """Collect concrete tile footprints; False = some part unknown."""
+        in_specs = _spec_list(pv.in_specs)
+        out_specs = _spec_list(pv.out_specs)
+        out_shapes = _spec_list(pv.out_shape)
+        if in_specs is None or out_specs is None:
+            return False
+        for i, spec in enumerate(in_specs):
+            if spec is NONE:
+                continue  # unblocked operand: streamed whole, not tiled
+            if not isinstance(spec, BlockSpecVal) or spec.block is None:
+                return False
+            dt = None
+            if i < len(args) and isinstance(args[i], ArrayVal):
+                dt = args[i].dtype
+            nbytes = _footprint(spec.block, dt)
+            if nbytes is None:
+                return False
+            parts.append((f"in[{i}]", spec.block, dt, nbytes, True))
+        for i, spec in enumerate(out_specs):
+            if not isinstance(spec, BlockSpecVal) or spec.block is None:
+                return False
+            dt = None
+            if out_shapes and i < len(out_shapes) \
+                    and isinstance(out_shapes[i], ArrayVal):
+                dt = out_shapes[i].dtype
+            nbytes = _footprint(spec.block, dt)
+            if nbytes is None:
+                return False
+            parts.append((f"out[{i}]", spec.block, dt, nbytes, True))
+        scratch = _spec_list(pv.scratch)
+        if scratch is None:
+            return pv.scratch is UNKNOWN and bool(parts)
+        for i, s in enumerate(scratch):
+            if not isinstance(s, ScratchVal) or s.shape is None:
+                return False
+            nbytes = _footprint(s.shape, s.dtype)
+            if nbytes is None:
+                return False
+            parts.append((f"scratch[{i}]", s.shape, s.dtype, nbytes, False))
+        return bool(parts)
+
+    def _is_generator(self, node) -> bool:
+        cached = self._gen_cache.get(id(node))
+        if cached is None:
+            cached = any(isinstance(n, (ast.Yield, ast.YieldFrom))
+                         for n in walk_scope(node))
+            self._gen_cache[id(node)] = cached
+        return cached
+
+
+# -- helpers ------------------------------------------------------------------
+
+def _dim_arith(op, a, b):
+    ops = {ast.Add: ("+", lambda x, y: x + y),
+           ast.Sub: ("-", lambda x, y: x - y),
+           ast.Mult: ("*", lambda x, y: x * y),
+           ast.FloorDiv: ("//", lambda x, y: x // y if y else 0),
+           ast.Mod: ("%", lambda x, y: x % y if y else 0),
+           ast.Pow: ("**", lambda x, y: x ** y if 0 <= y < 64 else 0)}
+    if op not in ops:
+        return ANYDIM
+    sym, fn = ops[op]
+    if isinstance(a, int) and isinstance(b, int):
+        return fn(a, b)
+    varying = (isinstance(a, Sym) and a.varying) or \
+        (isinstance(b, Sym) and b.varying)
+    an = a.name if isinstance(a, Sym) else str(a)
+    bn = b.name if isinstance(b, Sym) else str(b)
+    return Sym(f"{an}{sym}{bn}", varying=varying)
+
+
+def _broadcast(a: ArrayVal, b: ArrayVal):
+    if len(a.shape) < len(b.shape):
+        a, b = b, a
+    pad = (1,) * (len(a.shape) - len(b.shape))
+    bs = pad + b.shape
+    out = []
+    for x, y in zip(a.shape, bs):
+        if x == y or y == 1:
+            out.append(x)
+        elif x == 1:
+            out.append(y)
+        elif isinstance(x, int) and isinstance(y, int):
+            return UNKNOWN  # concrete mismatch: a real error, not our rule
+        else:
+            out.append(join_dim(x, y))
+    return ArrayVal(tuple(out), a.dtype if a.dtype == b.dtype else None)
+
+
+def _const_slice(idx: ast.Slice):
+    def val(n, default):
+        if n is None:
+            return default
+        if isinstance(n, ast.Constant) and isinstance(n.value, int):
+            return n.value
+        return None
+    lo = val(idx.lower, 0)
+    hi = val(idx.upper, None)
+    if lo is None or (idx.upper is not None and hi is None) \
+            or idx.step is not None:
+        return None, None
+    return lo, hi
+
+
+def _slice_dim(dim, item: ast.Slice, frame, interp):
+    """The resulting dim of slicing a dim, or None when unknowable."""
+    if item.step is not None:
+        return None
+    lo = interp._eval(item.lower, frame) if item.lower is not None else None
+    hi = interp._eval(item.upper, frame) if item.upper is not None else None
+    lo_d = _as_dim(lo) if lo is not None else 0
+    if hi is None:
+        if lo_d == 0:
+            return dim
+        if isinstance(dim, int) and isinstance(lo_d, int):
+            return max(dim - lo_d, 0)
+        return _dim_arith(ast.Sub, dim, lo_d)
+    hi_d = _as_dim(hi)
+    if lo_d == 0:
+        if isinstance(dim, int) and isinstance(hi_d, int):
+            return min(dim, hi_d) if hi_d >= 0 else max(dim + hi_d, 0)
+        return hi_d
+    if isinstance(lo_d, int) and isinstance(hi_d, int) and lo_d >= 0 \
+            and hi_d >= 0:
+        return max(hi_d - lo_d, 0)
+    return _dim_arith(ast.Sub, hi_d, lo_d)
+
+
+def _axis_tuple(v) -> Optional[Tuple[str, ...]]:
+    if isinstance(v, StrVal):
+        return (v.value,)
+    if isinstance(v, TupleVal) and v.elts and all(
+            isinstance(e, StrVal) for e in v.elts):
+        return tuple(e.value for e in v.elts)
+    return None
+
+
+def _build_array(last, args, kwargs):
+    if last in _LIKE_BUILDERS:
+        return args[0] if args and isinstance(args[0], ArrayVal) else UNKNOWN
+    idx = 1 if last == "normal" or last == "uniform" else 0  # key first
+    shape_v = kwargs.get("shape")
+    if shape_v is None and len(args) > idx:
+        shape_v = args[idx]
+    dims = _dims_from(shape_v) if shape_v is not None else None
+    if dims is None:
+        return UNKNOWN
+    dt = _dtype_of(kwargs.get("dtype", UNKNOWN))
+    if dt is None:
+        # dtype may also be positional: zeros(shape, dtype) /
+        # full(shape, fill_value, dtype)
+        dt_idx = idx + (2 if last == "full" else 1)
+        if len(args) > dt_idx:
+            dt = _dtype_of(args[dt_idx])
+        if dt is None:
+            dt = "float32"
+    return ArrayVal(dims, dt)
+
+
+def _flatten_arrays(v) -> Optional[List[ArrayVal]]:
+    """Every output leaf as a concrete ArrayVal, or None when any leaf
+    is unknown/symbolic (then no donation verdict is possible)."""
+    if isinstance(v, ArrayVal):
+        return [v] if is_concrete(v) else None
+    if isinstance(v, TupleVal):
+        out: List[ArrayVal] = []
+        for e in v.elts:
+            sub = _flatten_arrays(e)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    return None
+
+
+def _spec_list(v) -> Optional[list]:
+    if isinstance(v, TupleVal):
+        return list(v.elts)
+    if isinstance(v, (BlockSpecVal, ScratchVal, ArrayVal)) or v is NONE:
+        return [v]
+    return None
+
+
+def _footprint(dims, dtype) -> Optional[int]:
+    n = 1
+    for d in dims:
+        if not isinstance(d, int):
+            return None
+        n *= d
+    return n * _DTYPE_NAMES.get(dtype, 4)
