@@ -454,6 +454,83 @@ class TestRT003BroadExcept:
         assert_fires(src, "RT003", "except Exception:")
 
 
+class TestRT005UnboundedRetry:
+    VIOLATION = """\
+        def keep_trying(op):
+            while True:
+                try:
+                    return op()
+                except Exception:  # transient: spin again
+                    continue
+        """
+
+    CLEAN = """\
+        import time
+
+        def keep_trying(op, backoff):
+            attempts = 0
+            while attempts < 5:
+                try:
+                    return op()
+                except Exception:  # transient: pace and retry under the bound
+                    attempts += 1
+                    time.sleep(backoff.next_delay(attempts))
+        """
+
+    def test_fires(self):
+        f = assert_fires(self.VIOLATION, "RT005", "except Exception:")
+        assert f.severity == Severity.WARNING
+
+    def test_clean_twin(self):
+        assert_quiet(self.CLEAN, "RT005")
+
+    def test_deadline_awareness_is_a_bound(self):
+        src = """\
+            def keep_trying(op, deadline):
+                while not deadline.expired:
+                    try:
+                        return op()
+                    except Exception:  # transient: the deadline ends the loop
+                        continue
+            """
+        assert_quiet(src, "RT005")
+
+    def test_message_loop_is_not_a_retry(self):
+        # a loop that blocks on a receive handles a NEW item per iteration
+        src = """\
+            def serve(conn, handle):
+                while True:
+                    try:
+                        msg = conn.recv()
+                    except OSError:  # peer went away mid-message
+                        continue
+                    handle(msg)
+            """
+        assert_quiet(src, "RT005")
+
+    def test_for_loop_is_bounded_by_construction(self):
+        src = """\
+            def keep_trying(op):
+                for _ in range(3):
+                    try:
+                        return op()
+                    except Exception:  # bounded by the range
+                        continue
+            """
+        assert_quiet(src, "RT005")
+
+    def test_reraising_handler_is_not_a_retry(self):
+        src = """\
+            def once(op):
+                while True:
+                    try:
+                        return op()
+                    except Exception as e:  # surface with context
+                        raise RuntimeError("op failed") from e
+            """
+        assert_quiet(src, "RT005")
+
+
 class TestRT004NonStaticStaticArg:
     VIOLATION = """\
         import jax
@@ -1193,7 +1270,7 @@ def test_every_rule_has_a_fixture():
     """Adding a rule without a fires+quiet fixture pair must fail CI."""
     covered = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
                "JX007", "JX008", "JX009", "PL001",
-               "RT001", "RT002", "RT003", "RT004",
+               "RT001", "RT002", "RT003", "RT004", "RT005",
                "CC001", "CC002", "CC003"}
     assert {r.id for r in all_rules()} == covered
 

@@ -1,0 +1,696 @@
+"""airfault: deterministic fault injection + the self-healing serve plane.
+
+Layers under test:
+  * FaultPlan/FaultSpec determinism — same seed, byte-identical schedule,
+    env-var round-trip (how plans reach worker processes);
+  * retry primitives — seeded Backoff, CircuitBreaker state machine on an
+    injected clock, Deadline, call_with_retry composition;
+  * scheduler deadline sweep — a queued request past its absolute deadline
+    fails with DeadlineExceededError instead of occupying a slot;
+  * DisaggRouter storm regression — replica death re-routes are BOUNDED and
+    PACED (recorded backoff sleeps), gray failures trip per-replica
+    breakers instead of killing replicas;
+  * proxy deadline ladder — exhausted budgets surface as HTTP 504 with
+    ``Retry-After``, both proxy-side and across the actor boundary;
+  * chaos (``-m chaos``): a seeded FaultPlan kills a serving replica out
+    from under pinned streams mid-decode — the journal replays them on a
+    survivor and every client finishes with zero non-200 after admission
+    and token-identical output vs offline greedy (docs/RESILIENCE.md).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpu_air
+from tpu_air import faults
+from tpu_air.engine import EngineConfig, InferenceEngine
+from tpu_air.faults import (
+    Backoff,
+    BreakerOpenError,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+    FaultInjectedError,
+    FaultPlan,
+    FaultSpec,
+    LeaseRevokedError,
+    call_with_retry,
+)
+from tpu_air.faults import plan as fault_state
+from tpu_air.models.lm import CausalLM, LMConfig
+from tpu_air.models.lm.generate import generate as lm_generate
+
+PORT = 8141
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = LMConfig.tiny()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+@pytest.fixture
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _prompts(seed, n, lo=3, hi=12, vocab=384):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(1, vocab, size=rng.randint(lo, hi))))
+            for _ in range(n)]
+
+
+def _offline(model, params, prompt, max_new):
+    return np.asarray(lm_generate(
+        model, params, [prompt], max_new_tokens=max_new,
+        eos_token_id=None))[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan determinism
+# ---------------------------------------------------------------------------
+
+
+def test_plan_same_seed_is_byte_identical():
+    a = FaultPlan.generate(seed=5)
+    b = FaultPlan.generate(seed=5)
+    assert a.to_json() == b.to_json()
+    assert a.to_json() == FaultPlan.from_json(a.to_json()).to_json()
+    assert FaultPlan.generate(seed=6).to_json() != a.to_json()
+
+
+def test_plan_env_round_trip(_clean_faults):
+    plan = FaultPlan(seed=3, specs=[
+        FaultSpec("proxy.poll", "kill", at=4),
+        FaultSpec("object_store.get", "delay", at=2, delay_s=0.05),
+    ])
+    faults.install(plan)
+    assert faults.enabled()
+    # what a worker process inherits and re-parses must be the same plan
+    raw = os.environ["TPU_AIR_FAULT_PLAN"]
+    assert FaultPlan.from_json(raw).to_json() == plan.to_json()
+    fault_state._sync_from_env()
+    assert faults.current_plan().to_json() == plan.to_json()
+    faults.clear()
+    assert not faults.enabled()
+    assert "TPU_AIR_FAULT_PLAN" not in os.environ
+
+
+def test_spec_fires_on_nth_hit_with_count_window(_clean_faults):
+    faults.install(FaultPlan(specs=[
+        FaultSpec("site.x", "kill", at=2, count=2)]))
+    fired = [fault_state.hit("site.x") is not None for _ in range(5)]
+    assert fired == [False, True, True, False, False]
+    st = faults.stats()
+    assert st["faults_injected"] == 2
+    assert st["fired"] == {"site.x:kill": 2}
+
+
+def test_spec_match_filters_by_key(_clean_faults):
+    faults.install(FaultPlan(specs=[
+        FaultSpec("site.y", "kill", at=1, match="replica-1")]))
+    assert fault_state.hit("site.y", key="replica-0") is None
+    assert fault_state.hit("site.y", key="replica-1-xyz") is not None
+
+
+def test_perturb_enacts_in_band_actions(_clean_faults):
+    faults.install(FaultPlan(specs=[
+        FaultSpec("a", "drop"),
+        FaultSpec("b", "error"),
+        FaultSpec("c", "revoke"),
+        FaultSpec("d", "kill"),
+        FaultSpec("e", "delay", delay_s=0.0),
+    ]))
+    with pytest.raises(TimeoutError):
+        fault_state.perturb("a")
+    with pytest.raises(FaultInjectedError):
+        fault_state.perturb("b")
+    with pytest.raises(LeaseRevokedError):
+        fault_state.perturb("c")
+    # kill is returned to the hook — only the site knows what dying means
+    spec = fault_state.perturb("d")
+    assert spec is not None and spec.action == "kill"
+    assert fault_state.perturb("e").action == "delay"
+    # no plan installed -> hooks are inert
+    faults.clear()
+    assert fault_state.perturb("a") is None
+
+
+def test_bad_spec_rejected():
+    with pytest.raises(ValueError):
+        FaultSpec("s", "kill", at=0)
+    with pytest.raises(ValueError):
+        FaultSpec("s", "delay", delay_s=-1.0)
+    with pytest.raises(ValueError):
+        FaultPlan.generate(seed=1, sites=["no.such.site"])
+
+
+# ---------------------------------------------------------------------------
+# retry primitives
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_deterministic_and_capped():
+    a = [Backoff(base=0.05, cap=1.0, seed=3).next_delay(i)
+         for i in range(1, 10)]
+    b = [Backoff(base=0.05, cap=1.0, seed=3).next_delay(i)
+         for i in range(1, 10)]
+    assert a == b  # seeded jitter: chaos runs replay identically
+    assert all(0 < d <= 1.0 for d in a)
+    # jitter scales within [1-jitter, 1] of the raw exponential
+    raw = [min(1.0, 0.05 * 2.0 ** (i - 1)) for i in range(1, 10)]
+    assert all(r * 0.5 <= d <= r for d, r in zip(a, raw))
+    with pytest.raises(ValueError):
+        Backoff(base=0.0)
+    with pytest.raises(ValueError):
+        Backoff(jitter=2.0)
+
+
+def test_breaker_open_half_open_close():
+    clk = [0.0]
+    b = CircuitBreaker(failure_threshold=2, reset_timeout_s=5.0,
+                       clock=lambda: clk[0])
+    assert b.state == CircuitBreaker.CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED  # below threshold
+    b.record_failure()
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.allow()
+    clk[0] = 5.0  # reset elapsed: exactly ONE half-open probe admitted
+    assert b.allow()
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert not b.allow()  # concurrent caller: probe already in flight
+    b.record_failure()  # probe failed: open again, clock restarted
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.allow()
+    clk[0] = 10.0
+    assert b.allow()
+    b.record_success()  # probe succeeded: closed, failure count reset
+    assert b.state == CircuitBreaker.CLOSED
+    b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED  # count restarted from zero
+
+
+def test_deadline_semantics():
+    assert Deadline.at_ms(None) is None
+    past = Deadline(time.time() * 1000.0 - 50.0)
+    assert past.expired and past.remaining_s() == 0.0
+    future = Deadline.after_ms(60_000.0)
+    assert not future.expired
+    assert 0.0 < future.remaining_s() <= 60.0
+
+
+def test_call_with_retry_paces_and_stops_at_deadline():
+    calls = []
+    sleeps = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TimeoutError("transient")
+        return "ok"
+
+    out = call_with_retry(flaky, attempts=5,
+                          backoff=Backoff(base=0.05, cap=1.0, seed=0),
+                          sleep=sleeps.append)
+    assert out == "ok" and len(calls) == 3
+    ref = Backoff(base=0.05, cap=1.0, seed=0)  # one instance: jitter rng draws sequentially
+    assert sleeps == [ref.next_delay(1), ref.next_delay(2)]
+
+    # an open breaker short-circuits without calling at all
+    clk = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=99.0,
+                       clock=lambda: clk[0])
+    b.record_failure()
+    with pytest.raises(BreakerOpenError):
+        call_with_retry(lambda: "never", breaker=b)
+
+    # a backoff wait that would overrun the deadline raises instead
+    def always_fails():
+        raise TimeoutError("down")
+
+    with pytest.raises(DeadlineExceededError):
+        call_with_retry(always_fails, attempts=5,
+                        backoff=Backoff(base=10.0, cap=10.0, jitter=0.0),
+                        deadline=Deadline.after_ms(1_000.0),
+                        sleep=lambda s: None)
+
+
+# ---------------------------------------------------------------------------
+# scheduler deadline sweep (queued work past its budget -> 504-class error)
+# ---------------------------------------------------------------------------
+
+
+def test_queued_request_past_deadline_expires(lm):
+    cfg, model, params = lm
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=2, slot_len=64, max_new_tokens=4),
+        auto_start=False,
+    )
+    try:
+        expired = engine.submit([5, 6, 7], 4,
+                                deadline_ms=time.time() * 1000.0 - 10.0)
+        alive = engine.submit([8, 9, 10], 4,
+                              deadline_ms=time.time() * 1000.0 + 600_000.0)
+        while not alive.done:
+            engine.step()
+        with pytest.raises(DeadlineExceededError):
+            expired.result(1.0)
+        assert alive.result(1.0) == _offline(model, params, [8, 9, 10], 4)
+        assert engine.scheduler.deadline_expired == 1
+        # the sweep gate drained with the queue: no lingering counter
+        assert engine.scheduler._deadlines == 0
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# DisaggRouter storm regression (satellite of the PR-8 death-reroute fix)
+# ---------------------------------------------------------------------------
+
+
+class _DeadWorker:
+    """prefill.remote raises like the actor boundary does on a corpse."""
+
+    class _Prefill:
+        @staticmethod
+        def remote(prompt, carrier):
+            from tpu_air.core.runtime import ActorDiedError
+            raise ActorDiedError("prefill replica is dead")
+
+    prefill = _Prefill()
+
+
+class _SlowWorker:
+    """prefill.remote times out — alive but gray-failing."""
+
+    class _Prefill:
+        @staticmethod
+        def remote(prompt, carrier):
+            raise TimeoutError("prefill rpc timed out")
+
+    prefill = _Prefill()
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.enqueued = []
+
+    def _make_request(self, prompt, max_new, stream, priority, **kw):
+        return ("req", list(prompt), kw)
+
+    def _enqueue(self, req):
+        self.enqueued.append(req)
+
+
+def _bare_router(workers, breaker_reset_s=5.0, clock=None):
+    """A DisaggRouter skeleton with injected workers/engine — the dispatch
+    loop under test without spawning actors or building a model."""
+    from tpu_air.engine.dist.router import DisaggRouter
+
+    r = object.__new__(DisaggRouter)
+    n = len(workers)
+    r.name = "storm-test"
+    r._prefill_timeout = 1.0
+    r._lock = threading.Lock()
+    r._rid = 0
+    r.fallbacks = 0
+    r.reroutes = 0
+    r.handoffs = 0
+    r._rr = 0
+    r._workers = list(workers)
+    r._alive = [True] * n
+    r._inflight = [0] * n
+    kw = {} if clock is None else {"clock": clock}
+    r._breakers = [
+        CircuitBreaker(failure_threshold=1, reset_timeout_s=breaker_reset_s,
+                       **kw)
+        for _ in range(n)
+    ]
+    r._backoff = Backoff(base=0.05, cap=1.0, seed=0)
+    sleeps = []
+    r._sleep = sleeps.append
+    r.retries = 0
+    r.engine = _FakeEngine()
+    return r, sleeps
+
+
+def test_router_death_reroute_is_bounded_and_paced():
+    """The storm regression: with every prefill replica dead, dispatch makes
+    at most one bounded, backed-off pass and falls back to local prefill —
+    not an unpaced hammer loop."""
+    from tpu_air.engine.types import ResponseStream
+
+    router, sleeps = _bare_router([_DeadWorker(), _DeadWorker(),
+                                   _DeadWorker()])
+    stream = ResponseStream(1)
+    router._dispatch_inner([1, 2, 3], 4, stream, None, "interactive")
+    # every replica tried once, confirmed dead, never retried
+    assert router.reroutes == 3 and router.retries == 3
+    assert router.live_prefill_replicas() == 0
+    assert router.fallbacks == 1 and len(router.engine.enqueued) == 1
+    # each failure was PACED by the seeded backoff (delays recorded, capped)
+    want = Backoff(base=0.05, cap=1.0, seed=0)
+    assert sleeps == [want.next_delay(i) for i in (1, 2, 3)]
+    # the fallback admitted through the drain-proof internal path with the
+    # deadline still attached
+    _, prompt, kw = router.engine.enqueued[0]
+    assert prompt == [1, 2, 3] and kw["admit_while_draining"] is True
+
+
+def test_router_gray_failure_trips_breaker_not_death():
+    """Timeouts are gray failures: the breaker opens (traffic stops) but
+    the replica stays alive, and a half-open probe restores it later."""
+    from tpu_air.engine.types import ResponseStream
+
+    clk = [0.0]
+    router, sleeps = _bare_router([_SlowWorker(), _SlowWorker()],
+                                  breaker_reset_s=5.0,
+                                  clock=lambda: clk[0])
+    stream = ResponseStream(1)
+    router._dispatch_inner([1, 2], 4, stream, None, "interactive")
+    # both replicas still ALIVE — only their breakers opened
+    assert router.live_prefill_replicas() == 2
+    assert router.reroutes == 0 and router.retries == 2
+    assert [b.state for b in router._breakers] == ["open", "open"]
+    assert router.fallbacks == 1  # no routable replica -> local prefill
+    assert len(sleeps) == 2
+    # after the reset timeout a probe is admitted again
+    clk[0] = 5.0
+    assert router._pick_replica() is not None
+
+
+def test_router_deadline_bounds_reroutes():
+    from tpu_air.engine.types import ResponseStream
+
+    router, _sleeps = _bare_router([_DeadWorker()])
+    stream = ResponseStream(1)
+    with pytest.raises(DeadlineExceededError):
+        router._dispatch_inner([1], 4, stream, None, "interactive",
+                               deadline_ms=time.time() * 1000.0 - 5.0)
+    assert router.retries == 0  # expired before the first attempt
+
+
+# ---------------------------------------------------------------------------
+# serve plane: deadlines over HTTP, chaos replay
+# ---------------------------------------------------------------------------
+
+
+def _post(path, payload, headers=None, port=PORT):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(path, port=PORT):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class _StreamClient(threading.Thread):
+    """Submit one stream, then poll (pinned) to completion, recording any
+    non-200 seen AFTER admission."""
+
+    def __init__(self, path, prompt, max_new, deadline_ms=None):
+        super().__init__(daemon=True)
+        self.path = path
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline_ms = deadline_ms
+        self.admitted = threading.Event()
+        self.tokens = None
+        self.bad_status = []
+
+    def run(self):
+        payload = {"action": "submit", "prompt": self.prompt,
+                   "max_new_tokens": self.max_new}
+        if self.deadline_ms is not None:
+            payload["deadline_ms"] = self.deadline_ms
+        status, out, hdrs = _post(self.path, payload)
+        if status != 200:
+            self.bad_status.append(("submit", status, out))
+            return
+        self.admitted.set()
+        rid = out["request_id"]
+        pin = {"x-tpu-air-replica": hdrs.get("x-tpu-air-replica", "")}
+        cursor, toks = 0, []
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            status, out, _ = _post(self.path, {
+                "action": "poll", "request_id": rid, "cursor": cursor,
+            }, headers=pin)
+            if status != 200:
+                self.bad_status.append(("poll", status, out))
+                return
+            got = out.get("tokens") or []
+            toks += got
+            cursor += len(got)
+            if out.get("done"):
+                self.tokens = toks
+                return
+            time.sleep(0.01)
+
+
+def test_proxy_maps_exhausted_deadline_to_504(lm, air, _clean_faults):
+    """Two deadline failure shapes over real HTTP: a pre-expired budget is
+    refused proxy-side, and a queued request that expires replica-side
+    crosses the actor boundary as a 504 + Retry-After on poll."""
+    from tpu_air import serve
+    from tpu_air.serve import EngineDeployment
+    from tpu_air.train import Checkpoint
+
+    cfg, model, params = lm
+    ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+    max_new = 48
+    try:
+        serve.run(
+            EngineDeployment.options(
+                name="lm-deadline", route_prefix="/dl", num_replicas=1,
+            ).bind(ckpt, EngineConfig(num_slots=1, slot_len=64,
+                                      max_new_tokens=max_new)),
+            port=PORT,
+        )
+        # (a) non-positive budget: refused before any replica work
+        status, out, hdrs = _post("/dl", {
+            "action": "submit", "prompt": [3, 4, 5],
+            "max_new_tokens": 4, "deadline_ms": -1,
+        })
+        assert status == 504, out
+        assert "DeadlineExceededError" in out["error"]
+        assert "Retry-After" in hdrs
+        # (b) occupy the single slot, then queue a 1ms-budget request
+        # behind it: the scheduler sweep expires it and the poll sees 504
+        occupier = _StreamClient("/dl", [7, 8, 9], max_new)
+        occupier.start()
+        assert occupier.admitted.wait(timeout=60.0)
+        status, out, hdrs = _post("/dl", {
+            "action": "submit", "prompt": [10, 11, 12],
+            "max_new_tokens": 4, "deadline_ms": 1,
+        })
+        assert status == 200, out  # admitted: expiry is detected at poll
+        rid = out["request_id"]
+        pin = {"x-tpu-air-replica": hdrs.get("x-tpu-air-replica", "")}
+        deadline = time.monotonic() + 60.0
+        status = 200
+        while time.monotonic() < deadline:
+            status, out, hdrs = _post("/dl", {
+                "action": "poll", "request_id": rid, "cursor": 0,
+            }, headers=pin)
+            if status != 200 or out.get("done"):
+                break
+            time.sleep(0.02)
+        assert status == 504, out
+        assert "DeadlineExceededError" in out["error"]
+        assert "Retry-After" in hdrs
+        occupier.join(timeout=120.0)
+        assert occupier.bad_status == [] and occupier.tokens is not None
+    finally:
+        serve.shutdown()
+
+
+@pytest.mark.chaos
+def test_replica_kill_mid_stream_replays_token_identical(lm, air,
+                                                         _clean_faults):
+    """The tentpole acceptance: a seeded FaultPlan kills a serving replica
+    out from under its pinned streams mid-decode.  The journal replays the
+    orphaned streams on the survivor with the delivered tokens as a forced
+    prefix — zero non-200 after admission, and every client's final token
+    list is identical to offline greedy decode."""
+    from tpu_air import serve
+    from tpu_air.serve import EngineDeployment
+    from tpu_air.serve.proxy import serve_control_stats
+    from tpu_air.train import Checkpoint
+
+    cfg, model, params = lm
+    ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+    prompts = _prompts(seed=11, n=4)
+    max_new = 32
+    plan = FaultPlan(seed=7, specs=[
+        FaultSpec("proxy.poll", "kill", at=3),
+    ])
+    # same seed, same schedule: installing the identical plan twice must
+    # serialize byte-identically (what the CI chaos matrix relies on)
+    assert plan.to_json() == FaultPlan.from_json(plan.to_json()).to_json()
+    try:
+        serve.run(
+            EngineDeployment.options(
+                name="lm-chaos", route_prefix="/chaos", num_replicas=2,
+            ).bind(ckpt, EngineConfig(num_slots=4, slot_len=64,
+                                      max_new_tokens=max_new)),
+            port=PORT,
+            fault_plan=plan,
+        )
+        clients = [_StreamClient("/chaos", p, max_new) for p in prompts]
+        for c in clients:
+            c.start()
+        for c in clients:
+            assert c.admitted.wait(timeout=120.0), c.bad_status
+        for c in clients:
+            c.join(timeout=180.0)
+            assert not c.is_alive()
+        # zero non-200 after admission; streams token-identical to offline
+        # greedy even though one replica died mid-decode
+        for c, p in zip(clients, prompts):
+            assert c.bad_status == [], c.bad_status
+            assert c.tokens == _offline(model, params, p, max_new)
+        # the fault FIRED and the journal replayed the orphaned streams
+        rec = serve_control_stats()["recovery"]
+        assert rec["faults"]["installed"] and rec["faults"]["seed"] == 7
+        assert rec["faults"]["fired"].get("proxy.poll:kill", 0) >= 1
+        assert rec["replays"] >= 1
+        assert rec["replay_failures"] == 0
+    finally:
+        serve.shutdown()
+        faults.clear()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_trifecta_disagg_serve(lm, air, _clean_faults):
+    """The CI chaos-lane trifecta: replica kill mid-decode + delayed
+    object-store gets + a prefill-worker death, all from one seeded plan
+    (seed pinned by the workflow matrix via TPU_AIR_FAULT_SEED), against a
+    disaggregated serve deployment under open-loop streaming load."""
+    from tpu_air import serve
+    from tpu_air.serve import EngineDeployment
+    from tpu_air.serve.proxy import serve_control_stats
+    from tpu_air.train import Checkpoint
+
+    seed = int(os.environ.get("TPU_AIR_FAULT_SEED", "23"))
+    plan = FaultPlan.generate(
+        seed, sites=["object_store.get", "prefill.worker", "proxy.poll"])
+    assert plan.to_json() == FaultPlan.generate(
+        seed, sites=["object_store.get", "prefill.worker",
+                     "proxy.poll"]).to_json()
+
+    cfg, model, params = lm
+    ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+    prompts = _prompts(seed=29, n=6)
+    max_new = 24
+    try:
+        serve.run(
+            EngineDeployment.options(
+                name="lm-trifecta", route_prefix="/trifecta",
+                num_replicas=2,
+            ).bind(ckpt, EngineConfig(num_slots=4, slot_len=64,
+                                      max_new_tokens=max_new, page_len=8),
+                   disagg={"prefill_replicas": 2}),
+            port=PORT,
+            fault_plan=plan,
+        )
+        clients = [_StreamClient("/trifecta", p, max_new) for p in prompts]
+        for c in clients:
+            c.start()
+            time.sleep(0.05)  # open-loop: arrivals spread over the faults
+        for c in clients:
+            assert c.admitted.wait(timeout=180.0), c.bad_status
+        for c in clients:
+            c.join(timeout=300.0)
+            assert not c.is_alive()
+        for c, p in zip(clients, prompts):
+            assert c.bad_status == [], c.bad_status
+            assert c.tokens == _offline(model, params, p, max_new)
+        rec = serve_control_stats()["recovery"]
+        assert rec["faults"]["installed"] and rec["faults"]["seed"] == seed
+        assert rec["faults"]["faults_injected"] >= 1
+        assert rec["replay_failures"] == 0
+    finally:
+        serve.shutdown()
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# train-side recovery: crash via FaultPlan, resume from latest checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_train_worker_kill_resumes_from_checkpoint(air, _clean_faults):
+    """A FaultPlan hard-kills the trial actor at its 3rd report (before
+    that report's checkpoint is retained).  FailureConfig recovery must
+    resume from the newest ON-DISK checkpoint — the crash destroyed the
+    session's in-memory list — and the loss trajectory must continue
+    from where it left off, not restart."""
+    from tpu_air.train import (
+        Checkpoint,
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    faults.install(FaultPlan(seed=1, specs=[
+        FaultSpec("train.report", "kill", at=3)]))
+
+    def loop(config):
+        from tpu_air.train import session
+
+        start = 0
+        if config.get("resume_from_checkpoint"):
+            ck = Checkpoint.from_directory(config["resume_from_checkpoint"])
+            start = ck.get_metrics()["epoch"]
+        for epoch in range(start, 4):
+            loss = 10.0 - epoch  # deterministic decreasing trajectory
+            ck = Checkpoint.from_model(
+                metrics={"epoch": epoch + 1, "loss": loss})
+            session.report({"epoch": epoch + 1, "loss": loss},
+                           checkpoint=ck)
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    # first attempt reported epochs 1, 2 then died at report 3 (the fresh
+    # actor's hit counter never re-reaches 3 across the resume's 2 reports)
+    assert r.error is None
+    assert r.metrics["epoch"] == 4
+    # the trajectory CONTINUED: the resumed attempt's reports are epochs
+    # 3 and 4, strictly extending the pre-crash trajectory
+    losses = [m["loss"] for m in r.metrics_history]
+    assert losses == [8.0, 7.0]
+    assert r.checkpoint is not None
+    assert r.checkpoint.get_metrics()["epoch"] == 4

@@ -1,4 +1,4 @@
-"""Actor-runtime hazard rules: RT001–RT003.
+"""Actor-runtime hazard rules: RT001–RT003, RT005.
 
 (RT004 lives in rules_jax.py — it shares the jit call-site machinery.)
 """
@@ -267,3 +267,95 @@ def rt003_broad_except(ctx: ModuleContext) -> List[Finding]:
             "type, or state why catching everything is correct in a "
             "trailing comment"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# RT005 — unbounded retry loop
+# ---------------------------------------------------------------------------
+
+# pacing: a call whose dotted name ends in sleep/wait, or mentions a
+# backoff object (`backoff.next_delay`, `self._backoff(...)`)
+_RT005_PACING = re.compile(r"(^|[._])(sleep|wait)$|backoff|next_delay")
+# attempt bound: a comparison touching an attempts/retries counter or a
+# max_* limit (`while attempts < max_attempts`, `if tries > MAX_TRIES`)
+_RT005_BOUND = re.compile(r"attempt|retries|tries|max_", re.IGNORECASE)
+# deadline awareness: any name that consults a deadline/budget
+_RT005_DEADLINE = re.compile(r"deadline|expired|remaining", re.IGNORECASE)
+# work consumption: a loop that blocks on a receive or pops a queue handles
+# a NEW item each iteration (message/worker loop) — that's not a retry of
+# one failing operation, and the blocking receive paces it besides
+_RT005_CONSUME = re.compile(r"(^|[._])(pop|popleft|recv|accept)$")
+
+
+def _rt005_swallows(handler: ast.ExceptHandler) -> bool:
+    """A handler that never leaves the loop (no raise/return/break anywhere
+    in its body) swallows the failure and lets the loop spin again."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+                return False
+    return True
+
+
+def _rt005_identifiers(loop: ast.While):
+    """Every identifier the loop touches — bare names and attribute tails
+    (`self._deadline` contributes both "self" and "_deadline")."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Name):
+            yield node.id
+        elif isinstance(node, ast.Attribute):
+            yield node.attr
+
+
+@rule("RT005", "unbounded-retry", Severity.WARNING,
+      "a while-loop that catches failures and spins again with no attempt "
+      "bound, no backoff and no deadline is a retry storm: it hammers the "
+      "failing target at full speed forever and can hold locks/slots while "
+      "doing it")
+def rt005_unbounded_retry(ctx: ModuleContext) -> List[Finding]:
+    out = []
+    for loop in ctx.nodes:
+        if not isinstance(loop, ast.While):
+            continue
+        # the failure-swallowing retry shape: a try inside the loop whose
+        # handler neither re-raises nor exits the loop.  (for-loops are
+        # bounded by construction and never fire.)
+        swallowed = None
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    if _rt005_swallows(handler):
+                        swallowed = handler
+                        break
+            if swallowed is not None:
+                break
+        if swallowed is None:
+            continue
+        idents = list(_rt005_identifiers(loop))
+        bounded = any(
+            any(_RT005_BOUND.search(i)
+                for n in ast.walk(cmp_node) for i in _cmp_idents(n))
+            for cmp_node in ast.walk(loop)
+            if isinstance(cmp_node, ast.Compare))
+        call_names = [name for node in ast.walk(loop)
+                      if isinstance(node, ast.Call)
+                      for name in [dotted(node.func)] if name is not None]
+        paced = any(_RT005_PACING.search(n) for n in call_names)
+        consumes = any(_RT005_CONSUME.search(n) for n in call_names)
+        deadline_aware = any(_RT005_DEADLINE.search(i) for i in idents)
+        if bounded or paced or consumes or deadline_aware:
+            continue
+        out.append(make_finding(
+            ctx, "RT005", swallowed,
+            "retry loop swallows failures with no attempt bound, backoff "
+            "or deadline — bound the attempts, pace them "
+            "(tpu_air.faults.retry.Backoff), and stop at the request's "
+            "deadline"))
+    return out
+
+
+def _cmp_idents(node: ast.AST):
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, ast.Attribute):
+        yield node.attr

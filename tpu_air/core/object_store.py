@@ -31,6 +31,7 @@ import time
 from typing import Any, Optional
 
 from . import serialization
+from tpu_air.faults import plan as _faults
 
 
 class ObjectRef:
@@ -265,6 +266,10 @@ class ObjectStore:
         return True
 
     def get(self, object_id: str, timeout: Optional[float] = None) -> Any:
+        if _faults.enabled():
+            # "delay" stalls the fetch; "drop" raises the same TimeoutError a
+            # real store timeout produces, so recovery paths see the true shape
+            _faults.perturb("object_store.get", key=object_id)
         if not self.wait_for(object_id, timeout):
             raise TimeoutError(f"object {object_id} not available after {timeout}s")
         if self._arena is not None:

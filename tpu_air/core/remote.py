@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional
 from . import runtime as rt
 from . import serialization
 from .object_store import ObjectRef, new_object_id
+from tpu_air.faults import plan as _faults
 from tpu_air.observability import tracing as _tracing
 
 
@@ -101,6 +102,12 @@ def _create_actor(cls, args, kwargs, resources, name=None) -> "ActorHandle":
 def _submit_actor_task(actor_id, method, args, kwargs) -> ObjectRef:
     trace_ctx = _tracing.current_propagation()
     ctx = rt.current_worker()
+    if _faults.enabled():
+        spec = _faults.perturb("actor.call", key=f"{actor_id}:{method}")
+        if spec is not None and spec.action == "kill" and ctx is None:
+            # crash the TARGET actor's process (no graceful shutdown) so the
+            # caller exercises the real pipe-EOF death path
+            rt.get_runtime().crash_actor(actor_id)
     if ctx is not None:
         task_id = new_object_id()
         payload, payload_ref = _pack_payload_local(ctx.store, (None, list(args), kwargs))

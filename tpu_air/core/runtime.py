@@ -45,6 +45,7 @@ from .object_store import ObjectRef, ObjectStore, new_object_id
 
 # airtrace propagation (stdlib-only module; the observability package pulls
 # in nothing heavy at import time)
+from tpu_air.faults import plan as _faults
 from tpu_air.observability import tracing as _tracing
 
 # --------------------------------------------------------------------------
@@ -220,9 +221,11 @@ def _worker_main(
         for k in list(os.environ):
             if k not in driver_env:
                 os.environ.pop(k, None)
-    # the tracing flag was read at import time, which for forkserver
-    # children predates the env application above — re-read it
+    # the tracing flag (and any installed fault plan) was read at import
+    # time, which for forkserver children predates the env application
+    # above — re-read both
     _tracing._sync_from_env()
+    _faults._sync_from_env()
     store = ObjectStore(store_root)
     _worker_ctx = _WorkerContext(conn, store, worker_id)
     actors: Dict[str, Any] = {}
@@ -869,13 +872,23 @@ class Runtime:
         self._check_satisfiable({"chip": float(n)})
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            ids = None
             with self.lock:
                 if self._can_fit({"chip": float(n)}):
                     ids = self._claim_chips(
                         n, frozenset(self._queued_reservations()))
                     if ids is not None:
                         self._acquire({"chip": float(n)})
-                        return ids
+            if ids is not None:
+                if _faults.enabled():
+                    try:
+                        _faults.perturb("runtime.lease", key=str(n))
+                    except _faults.LeaseRevokedError:
+                        # the claim must not leak: hand the chips back
+                        # before surfacing the revocation
+                        self.release_chips(ids)
+                        raise
+                return ids
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"no {n}-chip lease available after {timeout}s")
             time.sleep(0.05)
@@ -911,6 +924,9 @@ class Runtime:
 
     def submit_task(self, fn, args, kwargs, resources: Dict[str, float],
                     trace_ctx: Optional[Dict[str, str]] = None) -> ObjectRef:
+        if _faults.enabled():
+            _faults.perturb(
+                "runtime.task", key=getattr(fn, "__name__", "") or "")
         self._check_satisfiable(resources)
         task_id = new_object_id()
         payload, payload_ref = self._pack_payload((fn, args, kwargs))
@@ -1292,6 +1308,21 @@ class Runtime:
         only construction time separates it from serving calls."""
         with self.lock:
             return any(r["actor_id"] == actor_id for r in self.actor_queue)
+
+    def crash_actor(self, actor_id: str) -> bool:
+        """Hard-kill an actor's worker process with NO bookkeeping — unlike
+        :meth:`kill_actor` there is no shutdown message, no join, and no
+        resource release here.  The listener thread discovers the corpse via
+        pipe EOF and runs the real ``_on_worker_death`` path, which is
+        exactly what fault injection needs: a crash indistinguishable from
+        an involuntary one.  Returns False if the actor is unknown/dead."""
+        with self.lock:
+            st = self.actors.get(actor_id)
+            if st is None or st.dead:
+                return False
+            proc = st.worker.proc
+        _kill_quietly(proc)
+        return True
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         with self.lock:

@@ -16,6 +16,8 @@ from typing import Dict, List
 
 import numpy as np
 
+from tpu_air.faults import plan as _faults
+
 
 def _kv_layers(cache, path=()):
     """Yield ``('/'.join(path), layer_dict)`` for every attention-layer
@@ -32,6 +34,8 @@ def _kv_layers(cache, path=()):
 def extract_kv_pages(cache, page_ids) -> Dict[str, Dict[str, np.ndarray]]:
     """Pull pages ``page_ids`` (in prompt order) out of a paged cache as
     host arrays: ``{layer_path: {"k": [n, page_len, h*d], "v": ...}}``."""
+    if _faults.enabled():
+        _faults.perturb("kv.transfer", key=str(len(page_ids)))
     ids = np.asarray(page_ids, np.int32)
     out = {}
     for path, layer in _kv_layers(cache):

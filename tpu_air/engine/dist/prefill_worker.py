@@ -21,8 +21,11 @@ construction works too (the unit-test path).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, Optional
+
+from tpu_air.faults import plan as _faults
 
 from .kv_transfer import extract_kv_pages, payload_nbytes, payload_pages
 
@@ -85,6 +88,13 @@ class PrefillWorker:
         import tpu_air
         from tpu_air.observability.tracing import task_span
 
+        if _faults.enabled():
+            # "slow" sleeps past the router's prefill timeout (gray failure:
+            # alive but useless); "kill" dies the involuntary way — no
+            # cleanup, the router sees the actor-death sentinel
+            spec = _faults.perturb("prefill.worker", key=self.name)
+            if spec is not None and spec.action == "kill":
+                os._exit(1)
         self._ensure_built()
         prompt = [int(t) for t in prompt]
         n = len(prompt)

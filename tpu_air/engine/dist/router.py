@@ -13,11 +13,16 @@ immediately; a per-request dispatcher thread then
    ``submit_prefilled`` — the decode engine's OWN capacity gate applies,
    so pool exhaustion defers the handoff in its admission queue instead
    of dropping it;
-3. on prefill-replica death (``ActorDiedError``/``RemoteError``/rpc
-   timeout) marks the replica dead and retries the next live one; with
-   NO live replicas left it falls back to a plain ``engine.submit`` on
-   the same stream — the decode engine prefills locally.  Either way the
-   caller's stream completes and in-flight decode streams never notice.
+3. on prefill-replica death (``ActorDiedError``/``RemoteError``) marks
+   the replica dead and re-routes under the retry discipline — bounded
+   attempts with capped-exponential backoff + jitter, never past the
+   request's deadline; an rpc TIMEOUT is treated as gray failure (alive
+   but too slow): it trips the replica's circuit breaker rather than
+   killing it, and a half-open probe restores the replica when it
+   recovers.  With no routable replica left it falls back to a plain
+   ``engine.submit`` on the same stream — the decode engine prefills
+   locally.  Either way the caller's stream completes and in-flight
+   decode streams never notice.
 
 Tracing: the carrier captured at ``submit`` rides to the worker (its
 ``engine.prefill`` span) and wraps the transfer + handoff
@@ -30,9 +35,16 @@ processes.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from tpu_air.core.runtime import ActorDiedError, RemoteError
+from tpu_air.faults.retry import (
+    Backoff,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+)
 
 from ..types import EngineConfig, ResponseStream
 
@@ -44,7 +56,7 @@ class DisaggRouter:
                  *, prefill_replicas: int = 2, dtype: Optional[str] = None,
                  mesh: Optional[tuple] = None, engine=None,
                  prefill_timeout: float = 120.0, worker_pages: Optional[int] = None,
-                 name: str = "disagg"):
+                 breaker_reset_s: float = 5.0, name: str = "disagg"):
         if prefill_replicas < 1:
             raise ValueError("prefill_replicas must be >= 1")
         self.name = name
@@ -89,6 +101,16 @@ class DisaggRouter:
         ]
         self._alive = [True] * prefill_replicas
         self._inflight = [0] * prefill_replicas
+        # retry discipline (tpu_air.faults.retry): one breaker per replica
+        # gates gray failures, one seeded backoff paces re-routes.  _sleep
+        # is injectable so the storm regression test can record the delays.
+        self._breakers = [
+            CircuitBreaker(failure_threshold=1, reset_timeout_s=breaker_reset_s)
+            for _ in range(prefill_replicas)
+        ]
+        self._backoff = Backoff(base=0.05, cap=1.0, seed=0)
+        self._sleep = time.sleep
+        self.retries = 0
         self.engine.metrics.set_topology(
             disagg="on", prefill_replicas=prefill_replicas,
             role="decode",
@@ -96,12 +118,16 @@ class DisaggRouter:
 
     # -- submission ------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
-               priority: str = "interactive") -> ResponseStream:
+               priority: str = "interactive",
+               deadline_ms: Optional[float] = None) -> ResponseStream:
         """Queue one prompt through the disaggregated path; the stream is
         live immediately (tokens start at first-token handoff).
         ``priority`` rides through to the decode engine's admission (the
         handoff itself bypasses a decode-side drain — this router admitted
-        the work before any drain began)."""
+        the work before any drain began).  ``deadline_ms`` (absolute
+        unix-epoch ms) bounds the whole dispatch: re-routes never retry
+        past it, and the decode engine's queue sweep enforces it after
+        handoff."""
         from tpu_air.observability.tracing import current_propagation
 
         # surface draining at the front door, BEFORE spending prefill work
@@ -117,7 +143,8 @@ class DisaggRouter:
         carrier = current_propagation()
         t = threading.Thread(
             target=self._dispatch,
-            args=(list(prompt), max_new_tokens, stream, carrier, priority),
+            args=(list(prompt), max_new_tokens, stream, carrier, priority,
+                  deadline_ms),
             name=f"{self.name}-dispatch-{rid}", daemon=True,
         )
         t.start()
@@ -135,13 +162,20 @@ class DisaggRouter:
             if not live:
                 return None
             # least-loaded wins; ties rotate round-robin so a stream of
-            # sequential (never-overlapping) requests still spreads
+            # sequential (never-overlapping) requests still spreads.  The
+            # first candidate whose breaker admits traffic is taken —
+            # allow() is only called until it first answers True, so a
+            # half-open probe slot is never consumed by a replica we then
+            # don't call.
             n = len(self._workers)
-            i = min(live,
-                    key=lambda j: (self._inflight[j], (j - self._rr) % n))
-            self._rr = i + 1
-            self._inflight[i] += 1
-            return i
+            ranked = sorted(
+                live, key=lambda j: (self._inflight[j], (j - self._rr) % n))
+            for i in ranked:
+                if self._breakers[i].allow():
+                    self._rr = i + 1
+                    self._inflight[i] += 1
+                    return i
+            return None
 
     def _mark_dead(self, i: int) -> None:
         with self._lock:
@@ -167,29 +201,56 @@ class DisaggRouter:
         return self.engine.drained()
 
     # -- the per-request dispatcher -------------------------------------------
-    def _dispatch(self, prompt, max_new, stream, carrier, priority) -> None:
+    def _dispatch(self, prompt, max_new, stream, carrier, priority,
+                  deadline_ms=None) -> None:
         try:
-            self._dispatch_inner(prompt, max_new, stream, carrier, priority)
+            self._dispatch_inner(prompt, max_new, stream, carrier, priority,
+                                 deadline_ms)
         except BaseException as e:  # never strand the caller's stream
             stream._finish(e)
 
     def _dispatch_inner(self, prompt, max_new, stream, carrier,
-                        priority) -> None:
+                        priority, deadline_ms=None) -> None:
         import tpu_air
         from tpu_air.observability.tracing import task_span
 
         from .kv_transfer import payload_nbytes, payload_pages
 
+        deadline = Deadline.at_ms(deadline_ms)
+        # bounded re-route (the death-storm fix): at most two passes over
+        # the replica set, capped-exponential backoff + jitter between
+        # failures, and no attempt ever launched past the deadline
+        max_attempts = 2 * len(self._workers)
         result = None
-        while result is None:
+        attempts = 0
+        while result is None and attempts < max_attempts:
+            if deadline is not None and deadline.expired:
+                raise DeadlineExceededError(
+                    f"deadline passed during prefill re-route "
+                    f"(after {attempts} failed attempts)")
             i = self._pick_replica()
             if i is None:
-                break  # every prefill replica is dead
+                break  # every prefill replica is dead or breaker-open
             try:
                 ref = self._workers[i].prefill.remote(prompt, carrier)
                 result = tpu_air.get(ref, timeout=self._prefill_timeout)
-            except (ActorDiedError, RemoteError, TimeoutError):
+                self._breakers[i].record_success()
+            except (ActorDiedError, RemoteError):
+                # confirmed death: out of rotation permanently (respawn is
+                # the deployment layer's job, not this router's)
                 self._mark_dead(i)
+                attempts += 1
+                with self._lock:
+                    self.retries += 1
+                self._sleep(self._backoff.next_delay(attempts))
+            except TimeoutError:
+                # gray failure: alive but too slow — trip the breaker; its
+                # half-open probe restores the replica if it recovers
+                self._breakers[i].record_failure()
+                attempts += 1
+                with self._lock:
+                    self.retries += 1
+                self._sleep(self._backoff.next_delay(attempts))
             finally:
                 with self._lock:
                     self._inflight[i] -= 1
@@ -203,7 +264,7 @@ class DisaggRouter:
             # drain that began mid-dispatch instead of erroring the stream
             self.engine._enqueue(self.engine._make_request(
                 prompt, max_new, stream, priority,
-                admit_while_draining=True))
+                admit_while_draining=True, deadline_ms=deadline_ms))
             return
         with task_span("engine.kv_transfer", carrier) as sp:
             payload = tpu_air.get(result["kv"])
@@ -217,7 +278,7 @@ class DisaggRouter:
             # parent: decode joins the same trace as prefill + transfer
             self.engine.submit_prefilled(
                 prompt, result["first_token"], payload, max_new,
-                stream=stream, priority=priority)
+                stream=stream, priority=priority, deadline_ms=deadline_ms)
         with self._lock:
             self.handoffs += 1
 
@@ -231,6 +292,8 @@ class DisaggRouter:
                 "handoffs": self.handoffs,
                 "reroutes": self.reroutes,
                 "fallbacks": self.fallbacks,
+                "retries": self.retries,
+                "breakers": [b.state for b in self._breakers],
             }
             alive = list(self._alive)  # snapshot: _mark_dead runs concurrently
         worker_stats = []

@@ -176,7 +176,8 @@ class InferenceEngine:
     # -- submission (any thread) ---------------------------------------------
     def _make_request(self, prompt, max_new_tokens, stream,
                       priority: str = "interactive", *,
-                      admit_while_draining: bool = False) -> Request:
+                      admit_while_draining: bool = False,
+                      deadline_ms: Optional[float] = None) -> Request:
         """Shared validation + Request construction for both submit paths.
 
         ``admit_while_draining`` is the disaggregated-handoff escape hatch:
@@ -214,7 +215,9 @@ class InferenceEngine:
         return Request(request_id=rid, prompt=prompt, max_new_tokens=budget,
                        stream=stream if stream is not None
                        else ResponseStream(rid),
-                       priority=priority)
+                       priority=priority,
+                       deadline_ms=(None if deadline_ms is None
+                                    else float(deadline_ms)))
 
     def _enqueue(self, req: Request) -> ResponseStream:
         try:
@@ -228,7 +231,8 @@ class InferenceEngine:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None, *,
                priority: str = "interactive",
-               stream: Optional[ResponseStream] = None) -> ResponseStream:
+               stream: Optional[ResponseStream] = None,
+               deadline_ms: Optional[float] = None) -> ResponseStream:
         """Queue one prompt; returns its token stream immediately.
 
         ``priority`` is the request's SLO class (``types.PRIORITIES``):
@@ -236,15 +240,21 @@ class InferenceEngine:
         best-effort sheds at half the queue depth interactive does.
         ``stream`` lets a front-end that already handed a stream to its
         caller (the disagg router's prefill-fallback path) have the engine
-        emit onto it instead of minting a fresh one."""
+        emit onto it instead of minting a fresh one.  ``deadline_ms`` is
+        the request's ABSOLUTE end-to-end deadline (unix-epoch ms): still
+        queued past it, the request expires with
+        :class:`~tpu_air.faults.retry.DeadlineExceededError` instead of
+        occupying a slot it can no longer use."""
         return self._enqueue(self._make_request(prompt, max_new_tokens,
-                                                stream, priority))
+                                                stream, priority,
+                                                deadline_ms=deadline_ms))
 
     def submit_prefilled(self, prompt: Sequence[int], first_token: int,
                          kv_pages: Dict[str, Any],
                          max_new_tokens: Optional[int] = None, *,
                          priority: str = "interactive",
-                         stream: Optional[ResponseStream] = None
+                         stream: Optional[ResponseStream] = None,
+                         deadline_ms: Optional[float] = None
                          ) -> ResponseStream:
         """Queue a request whose prefill ALREADY RAN elsewhere (a
         PrefillWorker replica — engine/dist/): ``kv_pages`` is the
@@ -260,7 +270,8 @@ class InferenceEngine:
         # a handoff rides through a drain: the router admitted it before the
         # drain started and its prefill already ran on another replica
         req = self._make_request(prompt, max_new_tokens, stream, priority,
-                                 admit_while_draining=True)
+                                 admit_while_draining=True,
+                                 deadline_ms=deadline_ms)
         req.prefilled = {"first_token": int(first_token), "pages": kv_pages}
         return self._enqueue(req)
 
@@ -309,6 +320,7 @@ class InferenceEngine:
                 self.scheduler.depth(), self.slots.occupancy(),
                 queue_by_class=self.scheduler.depth_by_class(),
                 draining=self._draining,
+                deadline_expired=self.scheduler.deadline_expired,
                 **gauges
             )
             return worked

@@ -69,6 +69,9 @@ class EngineMetrics:
         }
         self.queue_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self.draining = False
+        # queued requests swept past their absolute deadline_ms (the proxy
+        # maps these to HTTP 504 — docs/RESILIENCE.md)
+        self.deadline_expired = 0
         # distributions / rates
         self._ttft_h = Histogram()
         self._step_h = Histogram()
@@ -97,7 +100,8 @@ class EngineMetrics:
                        reordered_admits: int = None,
                        prefill_chunks: int = None,
                        queue_by_class: Dict[str, int] = None,
-                       draining: bool = None) -> None:
+                       draining: bool = None,
+                       deadline_expired: int = None) -> None:
         with self._lock:
             self.queue_depth = queue_depth
             self.slot_occupancy = slot_occupancy
@@ -111,6 +115,8 @@ class EngineMetrics:
                 self.queue_by_class = dict(queue_by_class)
             if draining is not None:
                 self.draining = bool(draining)
+            if deadline_expired is not None:
+                self.deadline_expired = deadline_expired
 
     def record_submit(self, priority: str = "interactive") -> None:
         with self._lock:
@@ -209,6 +215,7 @@ class EngineMetrics:
                 "ttft_s": self._ttft_h.summary(),
                 "step_latency_s": self._step_h.summary(),
                 "draining": self.draining,
+                "deadline_expired": self.deadline_expired,
                 "priority": {
                     p: {
                         "submitted": self.submitted_by_class[p],
@@ -263,7 +270,8 @@ def merge_snapshots(snapshots: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     out: Dict[str, Any] = {"engines": len(snaps)}
     for key in ("num_slots", "queue_depth", "slot_occupancy",
                 "requests_submitted", "requests_rejected",
-                "requests_completed", "tokens_emitted"):
+                "requests_completed", "tokens_emitted",
+                "deadline_expired"):
         out[key] = sum(int(s.get(key, 0)) for s in snaps)
     out["tokens_per_s"] = sum(float(s.get("tokens_per_s", 0.0))
                               for s in snaps)
@@ -298,6 +306,8 @@ _FAMILIES = [
     ("tpu_air_engine_requests_rejected", "counter",
      "requests shed under backpressure"),
     ("tpu_air_engine_requests_completed", "counter", "requests retired"),
+    ("tpu_air_engine_deadline_expired", "counter",
+     "queued requests swept past their absolute deadline (served as 504)"),
     ("tpu_air_engine_tokens_emitted", "counter", "tokens streamed out"),
     ("tpu_air_engine_tokens_per_s", "gauge",
      "emitted tokens/s over the rate window"),
@@ -370,7 +380,7 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
         tag = f'{{engine="{label}"}}'
         for key in ("queue_depth", "slot_occupancy", "requests_submitted",
                     "requests_rejected", "requests_completed",
-                    "tokens_emitted"):
+                    "deadline_expired", "tokens_emitted"):
             if key in snap:
                 b.raw(f"tpu_air_engine_{key}",
                       f"tpu_air_engine_{key}{tag} {snap[key]}")

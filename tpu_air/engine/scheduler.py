@@ -33,9 +33,11 @@ wins.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Deque, Dict, List
 
+from tpu_air.faults.retry import DeadlineExceededError
 from tpu_air.observability import tracing as _tracing
 
 from .types import PRIORITIES, EngineConfig, EngineOverloadedError, Request
@@ -55,6 +57,13 @@ class Scheduler:
         self.reordered_admits = 0  # admissions that jumped a blocked head
         # engine-side sheds by class (admission-queue rejections)
         self.rejected_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        # end-to-end deadlines: queued requests past Request.deadline_ms are
+        # expired (stream fails with DeadlineExceededError → proxy 504)
+        # instead of occupying a slot they can no longer use.  _deadlines
+        # counts queued deadline-carrying requests so the per-round sweep is
+        # free for deadline-less traffic.
+        self.deadline_expired = 0
+        self._deadlines = 0
 
     # -- producer side (any thread) ------------------------------------------
     def submit(self, request: Request) -> None:
@@ -84,6 +93,8 @@ class Scheduler:
                     f"max_queue={self.config.max_queue})"
                 )
             self._queues[request.priority].append(request)
+            if request.deadline_ms is not None:
+                self._deadlines += 1
             self._work.set()
 
     # -- engine-loop side ----------------------------------------------------
@@ -104,6 +115,7 @@ class Scheduler:
         out: List[Request] = []
         window = getattr(self.config, "reorder_window", 0)
         with self._lock:
+            self._sweep_expired_locked()
             for priority in PRIORITIES:
                 queue = self._queues[priority]
                 blocked = False
@@ -127,6 +139,9 @@ class Scheduler:
                     self.reordered_admits += 1
                 if blocked or len(out) >= free_slots:
                     break
+            for r in out:
+                if r.deadline_ms is not None:
+                    self._deadlines -= 1
             if not any(self._queues.values()):
                 self._work.clear()
         if _tracing.enabled() and out:
@@ -135,6 +150,31 @@ class Scheduler:
                 if r.t_submit_ns:
                     r.t_admit_ns = t
         return out
+
+    def _sweep_expired_locked(self) -> None:
+        """Expire queued requests past their deadline (caller holds _lock).
+        ``stream._finish`` is non-blocking (event set + queue put), safe
+        under the lock; one wall-clock read covers the whole sweep."""
+        if not self._deadlines:
+            return
+        now_ms = time.time() * 1000.0
+        for q in self._queues.values():
+            expired = [r for r in q
+                       if r.deadline_ms is not None
+                       and now_ms >= r.deadline_ms]
+            if not expired:
+                continue
+            dead = {id(r) for r in expired}
+            keep = [r for r in q if id(r) not in dead]
+            q.clear()
+            q.extend(keep)
+            for r in expired:
+                self.deadline_expired += 1
+                self._deadlines -= 1
+                r.stream._finish(DeadlineExceededError(
+                    f"request {r.request_id} missed its deadline while "
+                    f"queued ({r.priority}-class, deadline_ms="
+                    f"{r.deadline_ms:.0f})"))
 
     def depth(self) -> int:
         with self._lock:
@@ -151,6 +191,7 @@ class Scheduler:
             out = [r for p in PRIORITIES for r in self._queues[p]]
             for q in self._queues.values():
                 q.clear()
+            self._deadlines = 0
             self._work.clear()
         return out
 

@@ -231,3 +231,9 @@ class Request:
     # admission inserts the pages and goes straight to decode.  None for
     # the normal (engine-prefills) path.
     prefilled: Optional[dict] = None
+    # end-to-end deadline as ABSOLUTE unix-epoch milliseconds (a relative
+    # budget would silently re-extend at every hop).  The proxy converts
+    # the client's relative budget at admission; the scheduler expires
+    # still-queued requests past it (DeadlineExceededError → HTTP 504)
+    # rather than letting them occupy a slot they can no longer use.
+    deadline_ms: Optional[float] = None

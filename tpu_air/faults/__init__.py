@@ -1,0 +1,55 @@
+"""airfault — deterministic fault injection + the retry/recovery discipline.
+
+Two halves, both pure stdlib (see the module docstrings):
+
+* :mod:`tpu_air.faults.plan` — seeded :class:`FaultPlan` schedules enacted
+  by hooks woven through core/engine/serve/train; zero-cost when no plan
+  is installed.
+* :mod:`tpu_air.faults.retry` — :class:`Backoff`, :class:`CircuitBreaker`,
+  :class:`Deadline`, and :func:`call_with_retry`, the shared vocabulary of
+  every recovery path.
+
+docs/RESILIENCE.md is the user-facing guide.
+"""
+
+from tpu_air.faults.plan import (
+    FaultInjectedError,
+    FaultPlan,
+    FaultSpec,
+    LeaseRevokedError,
+    clear,
+    current_plan,
+    enabled,
+    hit,
+    install,
+    perturb,
+    stats,
+)
+from tpu_air.faults.retry import (
+    Backoff,
+    BreakerOpenError,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+    call_with_retry,
+)
+
+__all__ = [
+    "Backoff",
+    "BreakerOpenError",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceededError",
+    "FaultInjectedError",
+    "FaultPlan",
+    "FaultSpec",
+    "LeaseRevokedError",
+    "call_with_retry",
+    "clear",
+    "current_plan",
+    "enabled",
+    "hit",
+    "install",
+    "perturb",
+    "stats",
+]

@@ -1,0 +1,266 @@
+"""airfault — seeded, deterministic fault injection for the whole stack.
+
+A :class:`FaultPlan` is a seed plus a schedule of typed :class:`FaultSpec`
+entries.  Hooks are woven into the hot seams of the runtime — object-store
+gets, actor calls, chip leases, prefill workers, KV transfer, the serve
+proxy, and train's ``session.report`` — each one a single
+``if _faults.enabled():`` guard, so the cost with no plan installed is one
+module-global read (the same zero-cost-off contract as airtrace).
+
+Determinism contract: a spec fires on the *N-th eligible hit* of its site
+(per process, counted under a lock), and :meth:`FaultPlan.generate` derives
+its schedule from ``random.Random(seed)`` alone — same seed, same plan,
+byte-identical ``to_json()``, identical fault schedule on replay.
+
+Installation crosses process boundaries the same way tracing does: the
+plan is serialized into ``TPU_AIR_FAULT_PLAN`` in the driver's environ,
+``Runtime._spawn_worker`` ships that environ to every worker, and
+``_worker_main`` calls :func:`_sync_from_env` after applying it — so
+replica actors and prefill workers spawned after :func:`install` all see
+the same schedule.
+
+Sites and the actions they honor (the hook decides what "kill" means):
+
+====================  ==========================================
+site                  actions
+====================  ==========================================
+``object_store.get``  ``delay`` (slow fetch), ``drop`` (TimeoutError)
+``actor.call``        ``delay``, ``kill`` (crash the target actor)
+``runtime.task``      ``delay``
+``runtime.lease``     ``revoke`` (LeaseRevokedError after claim)
+``prefill.worker``    ``slow`` (gray failure), ``kill`` (os._exit)
+``kv.transfer``       ``delay``
+``proxy.request``     ``delay``
+``proxy.poll``        ``delay``, ``kill`` (crash the pinned replica)
+``train.report``      ``delay``, ``kill`` (os._exit mid-run)
+====================  ==========================================
+
+This module is pure stdlib and imports nothing from ``tpu_air`` — it sits
+at the bottom of the import graph so every hook site can import it at
+module load without cycles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = [
+    "FaultInjectedError",
+    "FaultPlan",
+    "FaultSpec",
+    "LeaseRevokedError",
+    "clear",
+    "current_plan",
+    "enabled",
+    "hit",
+    "install",
+    "perturb",
+    "stats",
+]
+
+_ENV_FLAG = "TPU_AIR_FAULT_PLAN"
+
+
+class FaultInjectedError(Exception):
+    """An explicitly injected error (action ``error``)."""
+
+
+class LeaseRevokedError(Exception):
+    """An injected chip-lease revocation (action ``revoke``)."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault.
+
+    ``site``     — hook name (see module docstring table).
+    ``action``   — what to do there: delay/slow/drop/error/revoke/kill.
+    ``at``       — fire on the N-th eligible hit of the site (1-based,
+                   counted per process).
+    ``count``    — keep firing for this many consecutive hits (gray
+                   failures are sustained slowness, not a single blip).
+    ``delay_s``  — sleep duration for delay/slow actions.
+    ``match``    — optional substring filter on the hit key (e.g. an
+                   actor id or object id); empty matches everything.
+    """
+
+    site: str
+    action: str
+    at: int = 1
+    count: int = 1
+    delay_s: float = 0.0
+    match: str = ""
+
+    def __post_init__(self):
+        if self.at < 1 or self.count < 1 or self.delay_s < 0:
+            raise ValueError(f"bad fault spec: {self}")
+
+
+@dataclass
+class FaultPlan:
+    """A seed plus an ordered schedule of faults."""
+
+    seed: int = 0
+    specs: List[FaultSpec] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        """Canonical serialization: sorted keys, no whitespace variance —
+        the determinism test asserts byte-identity across regenerations."""
+        return json.dumps(
+            {"seed": self.seed, "specs": [asdict(s) for s in self.specs]},
+            sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, raw: str) -> "FaultPlan":
+        d = json.loads(raw)
+        return cls(seed=int(d.get("seed", 0)),
+                   specs=[FaultSpec(**s) for s in d.get("specs", [])])
+
+    @classmethod
+    def generate(cls, seed: int,
+                 sites: Optional[List[str]] = None) -> "FaultPlan":
+        """Derive a schedule from the seed alone.  Each site template gets
+        a randomized trigger point (and delay where meaningful) from a
+        private ``random.Random(seed)`` — the CI chaos lane pins a seed
+        matrix and every run of a seed replays the identical schedule."""
+        rng = random.Random(seed)
+        templates = {
+            "object_store.get": lambda: FaultSpec(
+                "object_store.get", "delay", at=rng.randint(2, 8),
+                delay_s=round(rng.uniform(0.05, 0.3), 3)),
+            "prefill.worker": lambda: FaultSpec(
+                "prefill.worker", "kill", at=rng.randint(1, 3)),
+            "proxy.poll": lambda: FaultSpec(
+                "proxy.poll", "kill", at=rng.randint(2, 6)),
+            "proxy.request": lambda: FaultSpec(
+                "proxy.request", "delay", at=rng.randint(1, 4),
+                delay_s=round(rng.uniform(0.01, 0.1), 3)),
+            "train.report": lambda: FaultSpec(
+                "train.report", "kill", at=rng.randint(2, 4)),
+        }
+        chosen = sites if sites is not None else sorted(templates)
+        specs = []
+        for site in chosen:
+            if site not in templates:
+                raise ValueError(f"no generator template for site {site!r}")
+            specs.append(templates[site]())
+        return cls(seed=seed, specs=specs)
+
+
+# ---------------------------------------------------------------------------
+# process-local plan state
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_hits: Dict[int, int] = {}    # spec index -> eligible-hit count
+_fired: Dict[str, int] = {}   # "site:action" -> times fired
+
+
+def enabled() -> bool:
+    """Fast global check — every hook guards on this before doing work."""
+    return _plan is not None
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def install(plan: FaultPlan) -> None:
+    """Install a plan in this process AND export it to the environment so
+    worker processes spawned from now on inherit it (``_spawn_worker``
+    ships the driver's environ; ``_worker_main`` re-syncs)."""
+    global _plan
+    with _lock:
+        _plan = plan
+        _hits.clear()
+        _fired.clear()
+    os.environ[_ENV_FLAG] = plan.to_json()
+
+
+def clear() -> None:
+    global _plan
+    with _lock:
+        _plan = None
+        _hits.clear()
+        _fired.clear()
+    os.environ.pop(_ENV_FLAG, None)
+
+
+def _sync_from_env() -> None:
+    """Re-read the env plan.  Called by worker processes after the driver's
+    environ has been applied (mirrors ``tracing._sync_from_env``)."""
+    global _plan
+    raw = os.environ.get(_ENV_FLAG)
+    with _lock:
+        _plan = FaultPlan.from_json(raw) if raw else None
+        _hits.clear()
+        _fired.clear()
+
+
+def hit(site: str, key: str = "") -> Optional[FaultSpec]:
+    """Count one eligible hit of ``site`` and return the spec that fires
+    now, if any.  A spec fires on hits ``[at, at + count)`` of its site
+    (per process); ``match`` filters hits by key substring."""
+    plan = _plan
+    if plan is None:
+        return None
+    with _lock:
+        for i, spec in enumerate(plan.specs):
+            if spec.site != site:
+                continue
+            if spec.match and spec.match not in key:
+                continue
+            n = _hits.get(i, 0) + 1
+            _hits[i] = n
+            if spec.at <= n < spec.at + spec.count:
+                tag = f"{spec.site}:{spec.action}"
+                _fired[tag] = _fired.get(tag, 0) + 1
+                return spec
+    return None
+
+
+def perturb(site: str, key: str = "") -> Optional[FaultSpec]:
+    """The generic hook body: count the hit and enact in-band actions.
+
+    ``delay``/``slow`` sleep here; ``drop`` raises ``TimeoutError`` (the
+    same error a real store timeout produces); ``error`` raises
+    :class:`FaultInjectedError`; ``revoke`` raises
+    :class:`LeaseRevokedError`.  ``kill`` is returned to the caller — only
+    the hook site knows what dying means there (``os._exit`` in a worker,
+    ``crash_actor`` from the driver)."""
+    spec = hit(site, key)
+    if spec is None:
+        return None
+    if spec.action in ("delay", "slow"):
+        time.sleep(spec.delay_s)
+    elif spec.action == "drop":
+        raise TimeoutError(
+            f"airfault: injected drop at {site} (key={key!r})")
+    elif spec.action == "error":
+        raise FaultInjectedError(f"airfault: injected error at {site}")
+    elif spec.action == "revoke":
+        raise LeaseRevokedError(f"airfault: lease revoked at {site}")
+    return spec
+
+
+def stats() -> Dict[str, object]:
+    """Observability surface: what has fired in THIS process.  Exposed via
+    ``serve_control_stats()`` (the ``faults_injected`` row in
+    docs/OBSERVABILITY.md)."""
+    with _lock:
+        return {
+            "installed": _plan is not None,
+            "seed": _plan.seed if _plan is not None else None,
+            "faults_injected": sum(_fired.values()),
+            "fired": dict(_fired),
+        }
+
+
+_sync_from_env()

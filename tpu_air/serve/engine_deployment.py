@@ -152,6 +152,7 @@ class _EngineServer:
                 payload.get("prompt") or [],
                 payload.get("max_new_tokens"),
                 priority=payload.get("priority", "interactive"),
+                deadline_ms=payload.get("deadline_ms"),
             )}
         if action == "poll":
             return self.poll(int(payload.get("request_id", -1)),
@@ -166,9 +167,11 @@ class _EngineServer:
             raise ValueError('payload needs "prompt" or a non-empty "prompts"')
         max_new = payload.get("max_new_tokens")
         priority = payload.get("priority", "interactive")
+        deadline_ms = payload.get("deadline_ms")
         front = self._front()
+        kw = {} if deadline_ms is None else {"deadline_ms": float(deadline_ms)}
         # submit ALL before joining ANY — concurrent prompts share pool steps
-        streams = [front.submit(p, max_new, priority=priority)
+        streams = [front.submit(p, max_new, priority=priority, **kw)
                    for p in prompts]
         return {
             "results": [
@@ -180,9 +183,15 @@ class _EngineServer:
 
     # -- streaming path (HTTP actions above, or direct actor RPC) -------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
-               priority: str = "interactive") -> int:
+               priority: str = "interactive",
+               deadline_ms: Optional[float] = None) -> int:
+        # deadline_ms is absolute unix-epoch ms (the proxy converts the
+        # client's relative budget at admission).  Passed through only when
+        # set: the T5 window engine doesn't take it, and None means "no
+        # deadline" everywhere.
+        kw = {} if deadline_ms is None else {"deadline_ms": float(deadline_ms)}
         stream = self._front().submit(prompt, max_new_tokens,
-                                      priority=priority)
+                                      priority=priority, **kw)
         self._streams[stream.request_id] = stream
         return stream.request_id
 
@@ -192,6 +201,8 @@ class _EngineServer:
             toks = self._finished.get(request_id)
             if toks is None:
                 raise KeyError(f"unknown request_id {request_id}")
+            if isinstance(toks, BaseException):
+                raise toks  # failed-stream tombstone: every re-poll re-raises
             return {"tokens": toks[cursor:], "done": True}
         # read `done` BEFORE the tokens: done observed first guarantees the
         # token list is complete, so a client may stop at its first done
@@ -201,11 +212,17 @@ class _EngineServer:
         if done:
             # delivery completes with this response; move the stream to the
             # bounded tombstone map so drain_status stops counting it but a
-            # trailing confirmation poll still answers
+            # trailing confirmation poll still answers.  A FAILED stream
+            # surfaces its error instead of masquerading as a short success —
+            # DeadlineExceededError crosses the actor boundary as RemoteError
+            # and the proxy maps it to HTTP 504 with Retry-After.
             self._streams.pop(request_id, None)
-            self._finished[request_id] = toks
+            err = getattr(stream, "_error", None)
+            self._finished[request_id] = err if err is not None else toks
             while len(self._finished) > 512:
                 self._finished.pop(next(iter(self._finished)))
+            if err is not None:
+                raise err
         return {"tokens": toks[cursor:], "done": done}
 
     # -- draining (zero-downtime rollout / scale-down) ------------------------
@@ -249,7 +266,8 @@ class _EngineServer:
                 live_prefill_replicas=rst["live_prefill_replicas"],
             )
             snap["disagg"] = {k: rst[k] for k in
-                              ("handoffs", "reroutes", "fallbacks")}
+                              ("handoffs", "reroutes", "fallbacks",
+                               "retries", "breakers")}
         return snap
 
 

@@ -18,20 +18,37 @@ Serving contract under load (docs/SERVING.md §SLO-aware serving):
 * replica-side backpressure (``EngineOverloadedError``) and drain refusal
   (``EngineDrainingError``) both map to 503 — retry semantics, nothing
   broken;
+* every streaming submit with an explicit token budget is JOURNALED
+  (serve/supervisor.py): when a pinned poll finds its replica dead, the
+  proxy REPLAYS the request on a live replica with the already-streamed
+  tokens as a forced prefix — the client sees a stall, never a 5xx, and
+  greedy decoding keeps the stream token-identical;
+* clients may send ``deadline_ms`` (a RELATIVE budget in ms) on new work;
+  the proxy stamps the ABSOLUTE deadline at admission and propagates it
+  end-to-end — queue expiry, re-routes and replays all respect it, and an
+  exhausted budget maps to 504 + ``Retry-After``;
 * ``serve.rollout(prefix)`` swaps every replica zero-downtime (drain
   before kill — pinned polls keep landing on the draining replica until
   its streams are fully delivered).
+
+Deterministic chaos: ``serve.run(..., fault_plan=FaultPlan(...))``
+installs a seeded fault plan (tpu_air.faults) before replicas spawn, so
+the whole serve plane — proxy hooks, replicas, prefill workers — runs the
+same fault schedule for the same seed (docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from tpu_air.core import api as core_api
 from tpu_air.core.runtime import RemoteError
+from tpu_air.faults import plan as _faults
+from tpu_air.faults.retry import DeadlineExceededError
 from tpu_air.observability import tracing as _tracing
 
 from .admission import AdmissionController, AdmissionPolicy, AdmissionShedError
@@ -43,6 +60,7 @@ from .deployment import (
     ReplicaGoneError,
     start_replicas,
 )
+from .supervisor import RequestJournal, journaled_poll
 
 #: request header that pins streaming polls to the replica holding their
 #: stream; the proxy sets it on every routed response
@@ -83,6 +101,9 @@ class _ServeState:
         self.thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
         self.lock = threading.Lock()
+        # in-flight streaming requests (prompt + delivered prefix) for
+        # crash replay — serve/supervisor.py
+        self.journal = RequestJournal()
 
     def match(self, path: str):
         """Longest-prefix route match → ``(prefix, handle)`` (the prefix
@@ -181,13 +202,26 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = json.loads(body) if body else None
             except ValueError:
                 payload = None  # non-JSON body: the replica's adapter decides
+            action = payload.get("action") if isinstance(payload, dict) else None
+            call_timeout = 300.0
             if isinstance(payload, dict):
-                action = payload.get("action")
                 if action == "poll":
                     # already-admitted work: no admission, and the poll must
                     # land on the replica holding the stream's state
                     pin = self.headers.get(REPLICA_HEADER) or None
+                    if _faults.enabled():
+                        # deterministic chaos: delay this poll, or kill the
+                        # pinned replica out from under it — the replay
+                        # path's regression surface
+                        spec = _faults.perturb("proxy.poll", key=prefix)
+                        if (spec is not None and spec.action == "kill"
+                                and pin):
+                            from tpu_air.core.runtime import get_runtime
+                            get_runtime().crash_actor(pin)
                 else:
+                    if _faults.enabled():
+                        _faults.perturb("proxy.request", key=prefix)
+                    dirty = False
                     controller = _state.admission.get(prefix)
                     if controller is not None:
                         priority = str(
@@ -198,17 +232,61 @@ class _Handler(BaseHTTPRequestHandler):
                         if clamped is not None and clamped != payload.get(
                                 "max_new_tokens"):
                             payload["max_new_tokens"] = clamped
-                            body = json.dumps(payload).encode()
+                            dirty = True
+                    budget_ms = payload.get("deadline_ms")
+                    if budget_ms is not None:
+                        # clients send a RELATIVE budget; the proxy stamps
+                        # the ABSOLUTE unix-epoch deadline at admission so
+                        # every downstream hop (queue sweep, re-route,
+                        # replay) measures against one clock instead of
+                        # re-extending the budget per hop
+                        budget_ms = float(budget_ms)
+                        if budget_ms <= 0:
+                            raise DeadlineExceededError(
+                                "deadline_ms must be a positive budget in "
+                                f"milliseconds, got {budget_ms:g}")
+                        payload["deadline_ms"] = (
+                            time.time() * 1000.0 + budget_ms)
+                        dirty = True
+                        # the routed call itself must not outlive the budget
+                        call_timeout = min(300.0, budget_ms / 1000.0 + 5.0)
+                    if dirty:
+                        body = json.dumps(payload).encode()
             # failover path: replica death mid-request retries on a live
             # replica; only application errors surface as 500.  The serving
             # replica's tag rides back so streaming clients can pin polls.
-            result, tag = handle.call_http_sync_tagged(
-                body, timeout=300.0, pin=pin)
+            if action == "poll":
+                # journal-aware poll: keeps the delivered prefix current and
+                # replays the stream on a live replica if the pin is dead
+                result, tag = journaled_poll(
+                    _state.journal, handle, prefix, payload, pin,
+                    timeout=call_timeout)
+            else:
+                result, tag = handle.call_http_sync_tagged(
+                    body, timeout=call_timeout, pin=pin)
+                if (action == "submit" and isinstance(payload, dict)
+                        and isinstance(result, dict)
+                        and "request_id" in result
+                        and payload.get("max_new_tokens") is not None):
+                    # journal the admitted stream for crash replay (only
+                    # budgeted requests are replayable — see supervisor.py)
+                    _state.journal.record_submit(
+                        prefix, tag, int(result["request_id"]),
+                        prompt=payload.get("prompt") or [],
+                        max_new_tokens=payload["max_new_tokens"],
+                        priority=str(
+                            payload.get("priority") or "interactive"),
+                        deadline_ms=payload.get("deadline_ms"))
             self._respond(200, _to_jsonable(result),
                           headers={REPLICA_HEADER: tag})
         except AdmissionShedError as e:
             self._respond(503, {"error": f"AdmissionShedError: {e}"},
                           headers={"Retry-After": f"{e.retry_after_s:g}"})
+        except DeadlineExceededError as e:
+            # the end-to-end budget is exhausted: 504, and Retry-After says
+            # "re-issue with a fresh budget", distinct from 5xx breakage
+            self._respond(504, {"error": f"DeadlineExceededError: {e}"},
+                          headers={"Retry-After": "1"})
         except (NoLiveReplicasError, ReplicaGoneError) as e:
             self._respond(503, {"error": str(e)})
         except RemoteError as e:
@@ -219,6 +297,11 @@ class _Handler(BaseHTTPRequestHandler):
             if e.cause_repr.startswith(("EngineOverloadedError",
                                         "EngineDrainingError")):
                 self._respond(503, {"error": e.cause_repr})
+            elif e.cause_repr.startswith("DeadlineExceededError"):
+                # a deadline expiry raised replica-side (queue sweep /
+                # failed stream) crosses the actor boundary as RemoteError
+                self._respond(504, {"error": e.cause_repr},
+                              headers={"Retry-After": "1"})
             else:
                 self._respond(500, {"error": f"{type(e).__name__}: {e}"})
         except ValueError as e:
@@ -241,6 +324,7 @@ def run(
     route_prefix: Optional[str] = None,
     admission_policy: Optional[AdmissionPolicy] = None,
     autoscaler: Optional[AutoscalerConfig] = None,
+    fault_plan=None,
     _blocking: bool = False,
     **_ignored,
 ) -> DeploymentHandle:
@@ -251,11 +335,17 @@ def run(
     :class:`~tpu_air.serve.admission.AdmissionPolicy`; routes without an
     engine see empty gauges and admit everything, so plain deployments are
     unaffected).  Passing ``autoscaler=AutoscalerConfig(...)`` additionally
-    starts a gauge-driven replica scaling loop for this route."""
+    starts a gauge-driven replica scaling loop for this route.
+
+    ``fault_plan=FaultPlan(...)`` installs a seeded deterministic fault
+    plan (tpu_air.faults) for chaos testing — it must be installed before
+    the replicas spawn so they inherit it through the environment."""
     if not isinstance(target, Application):
         raise TypeError(
             "serve.run expects a bound Application — call Deployment.bind(...)"
         )
+    if fault_plan is not None:
+        _faults.install(fault_plan)
     prefix = route_prefix or target.deployment.route_prefix or "/"
     # Validate the port before starting replicas or mutating routes — a
     # port-mismatch failure must not leave a half-deployed application.
@@ -353,6 +443,8 @@ def shutdown() -> None:
             _state.server = None
             _state.thread = None
             _state.port = None
+        # retired replicas take their streams with them — drop the journal
+        _state.journal = RequestJournal()
 
 
 def replica_engine_stats() -> Dict[str, Dict[str, Any]]:
@@ -377,7 +469,8 @@ def serve_control_stats() -> Dict[str, Any]:
     with _state.lock:
         controllers = dict(_state.admission)
         scalers = dict(_state.autoscalers)
-    return {
+        journal = _state.journal
+    out: Dict[str, Any] = {
         prefix: {
             "admission": controller.stats(),
             "autoscaler": (scalers[prefix].stats()
@@ -385,6 +478,11 @@ def serve_control_stats() -> Dict[str, Any]:
         }
         for prefix, controller in controllers.items()
     }
+    # self-healing counters (route prefixes always start with "/", so the
+    # bare key can't collide): journal size, replays, replay failures, and
+    # the installed fault plan's injection ledger (docs/RESILIENCE.md)
+    out["recovery"] = {**journal.stats(), "faults": _faults.stats()}
+    return out
 
 
 def status() -> Dict[str, Any]:

@@ -1,0 +1,276 @@
+"""Self-healing serve plane: proxy-side request journal + stream replay.
+
+The deployment layer already *respawns* crashed replicas
+(``DeploymentHandle._control_tick`` prunes dead replicas and re-spawns the
+deficit) — what it cannot do is rescue the STREAMS that lived on the
+corpse: a streaming client whose pinned replica died used to see
+``ReplicaGoneError`` → HTTP 503, losing every token already decoded.
+
+This module closes that gap.  The proxy journals every streaming submit
+(prompt, clamped budget, priority, absolute deadline) and each poll's
+delivered-token prefix.  When a pinned poll hits a dead replica, the
+journal REPLAYS the request on a live replica: the original prompt plus
+the already-streamed tokens are re-submitted as a forced prefix with the
+remaining budget — greedy decoding is deterministic, so the continuation
+is token-identical to the stream the dead replica would have produced.
+The client keeps polling its ORIGINAL request id and pin header; the
+journal translates cursors across the redirect.  Net effect: a replica
+crash is a stall, never a 5xx and never a token lost or changed.
+
+Replay discipline comes from :mod:`tpu_air.faults.retry`: bounded
+attempts, capped-exponential backoff on overload/drain, and no attempt
+past the request's deadline (``DeadlineExceededError`` → proxy 504).
+
+Scope notes:
+
+* only streaming requests with an EXPLICIT ``max_new_tokens`` are
+  replayable — without the budget the proxy cannot compute the remaining
+  allowance for the continuation (the engine-side default is not visible
+  here);
+* greedy decoding only: a sampled continuation would not be
+  token-identical (that is a statement about sampling, not about replay);
+* the journal is per-proxy-process, bounded (FIFO eviction), and keyed by
+  ``(route prefix, replica tag, request id)`` — request ids are minted
+  per replica, so the pin disambiguates.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpu_air.core.runtime import RemoteError
+from tpu_air.faults.retry import Backoff, Deadline, DeadlineExceededError
+from tpu_air.observability import tracing as _tracing
+
+from .deployment import NoLiveReplicasError, ReplicaGoneError
+
+__all__ = ["JournalEntry", "RequestJournal", "journaled_poll"]
+
+
+@dataclass(eq=False)
+class JournalEntry:
+    """One in-flight streaming request as the proxy knows it."""
+
+    prefix: str
+    pin: str                      # replica tag of the ORIGINAL submit
+    request_id: int               # the id the client keeps polling
+    prompt: List[int]
+    max_new_tokens: Optional[int]
+    priority: str
+    deadline_ms: Optional[float]  # absolute unix-epoch ms
+    tokens: List[int] = field(default_factory=list)  # delivered prefix
+    done: bool = False
+    # after a replay: (new replica tag, new request id, token offset) — the
+    # continuation stream starts at ``offset`` of the client-visible stream
+    redirect: Optional[Tuple[str, int, int]] = None
+    replays: int = 0
+    # per-entry lock: replay must be exclusive per request, but must not
+    # serialize the whole journal for its duration
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+class RequestJournal:
+    """Bounded, thread-safe map of in-flight streaming requests."""
+
+    def __init__(self, cap: int = 1024):
+        self._cap = int(cap)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple[str, str, int], JournalEntry]" = (
+            OrderedDict())
+        self.replays = 0
+        self.replay_failures = 0
+
+    # -- bookkeeping (proxy handler threads) --------------------------------
+    def record_submit(self, prefix: str, pin: str, request_id: int, *,
+                      prompt, max_new_tokens: Optional[int],
+                      priority: str,
+                      deadline_ms: Optional[float]) -> None:
+        entry = JournalEntry(
+            prefix=prefix, pin=pin, request_id=int(request_id),
+            prompt=[int(t) for t in (prompt or [])],
+            max_new_tokens=(None if max_new_tokens is None
+                            else int(max_new_tokens)),
+            priority=str(priority),
+            deadline_ms=(None if deadline_ms is None else float(deadline_ms)))
+        with self._lock:
+            self._entries[(prefix, pin, int(request_id))] = entry
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+
+    def lookup(self, prefix: str, pin: Optional[str],
+               request_id: int) -> Optional[JournalEntry]:
+        if not pin:
+            return None
+        with self._lock:
+            return self._entries.get((prefix, pin, int(request_id)))
+
+    def record_progress(self, entry: JournalEntry, tokens: List[int],
+                        done: bool) -> None:
+        """``tokens`` is the FULL client-visible list so far (the proxy
+        polls upstream with cursor 0 precisely so the journal always holds
+        a complete prefix to replay from)."""
+        with entry.lock:
+            entry.tokens = list(tokens)
+            entry.done = bool(done)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "journal_size": len(self._entries),
+                "replays": self.replays,
+                "replay_failures": self.replay_failures,
+            }
+
+    # -- recovery ------------------------------------------------------------
+    def replay(self, handle, entry: JournalEntry, *,
+               timeout: float = 60.0,
+               sleep=time.sleep) -> Optional[Tuple[str, int, int]]:
+        """Re-submit ``entry`` on a live replica with the delivered tokens
+        as a forced prefix.  Returns the redirect tuple, or None when the
+        journal already holds the complete stream (nothing left to decode).
+        Raises when the request is not replayable or every attempt failed.
+        """
+        with entry.lock:
+            if entry.redirect is not None:
+                return entry.redirect  # another poll thread already replayed
+            if entry.done:
+                return None
+            if entry.max_new_tokens is None:
+                raise ReplicaGoneError(
+                    f"request {entry.request_id} on {entry.pin!r} is gone "
+                    "and not replayable (no explicit max_new_tokens)")
+            streamed = list(entry.tokens)
+            remaining = int(entry.max_new_tokens) - len(streamed)
+            if remaining <= 0:
+                entry.done = True  # fully delivered before the crash
+                return None
+            payload: Dict[str, Any] = {
+                "action": "submit",
+                "prompt": list(entry.prompt) + streamed,
+                "max_new_tokens": remaining,
+                "priority": entry.priority,
+            }
+            if entry.deadline_ms is not None:
+                payload["deadline_ms"] = entry.deadline_ms
+            body = json.dumps(payload).encode()
+            deadline = Deadline.at_ms(entry.deadline_ms)
+            backoff = Backoff(base=0.05, cap=1.0, seed=0)
+            last: Optional[BaseException] = None
+            with _tracing.span("serve.replay", attrs={
+                    "request_id": entry.request_id, "from": entry.pin,
+                    "streamed": len(streamed), "remaining": remaining}):
+                for attempt in range(1, 6):
+                    if deadline is not None and deadline.expired:
+                        self._count_failure()
+                        raise DeadlineExceededError(
+                            f"deadline passed while replaying request "
+                            f"{entry.request_id}") from last
+                    try:
+                        result, tag = handle.call_http_sync_tagged(
+                            body, timeout=timeout, pin=None)
+                        entry.redirect = (tag, int(result["request_id"]),
+                                          len(streamed))
+                        entry.replays += 1
+                        with self._lock:
+                            self.replays += 1
+                        return entry.redirect
+                    except RemoteError as e:
+                        # overload/drain is "retry later"; anything else is
+                        # a real error the client should see
+                        if not e.cause_repr.startswith(
+                                ("EngineOverloadedError",
+                                 "EngineDrainingError")):
+                            self._count_failure()
+                            raise
+                        last = e
+                    except NoLiveReplicasError as e:
+                        last = e  # respawn in progress: back off and retry
+                    delay = backoff.next_delay(attempt)
+                    if (deadline is not None
+                            and delay > deadline.remaining_s()):
+                        self._count_failure()
+                        raise DeadlineExceededError(
+                            f"replay backoff would overrun the deadline for "
+                            f"request {entry.request_id}") from last
+                    sleep(delay)
+            self._count_failure()
+            raise last  # type: ignore[misc]
+
+    def _count_failure(self) -> None:
+        with self._lock:
+            self.replay_failures += 1
+
+
+def journaled_poll(journal: RequestJournal, handle, prefix: str,
+                   payload: Dict[str, Any], pin: Optional[str], *,
+                   timeout: float = 300.0) -> Tuple[Dict[str, Any], str]:
+    """The proxy's poll path: serve the poll, keep the journal current,
+    and recover through a replay when the pinned replica is gone.
+
+    Returns ``(result, header_tag)`` — the header tag stays the ORIGINAL
+    pin across a redirect so the client never re-learns its pin."""
+    rid = int(payload.get("request_id", -1))
+    cursor = int(payload.get("cursor", 0))
+    entry = journal.lookup(prefix, pin, rid)
+    if entry is not None and (entry.redirect is not None or entry.done):
+        return _poll_redirected(journal, handle, entry, cursor,
+                                timeout=timeout), pin or ""
+    # upstream cursor is ALWAYS 0: the journal needs the full prefix to
+    # replay from, and the proxy slices the client's cursor locally
+    body = json.dumps({"action": "poll", "request_id": rid,
+                       "cursor": 0}).encode()
+    try:
+        result, tag = handle.call_http_sync_tagged(
+            body, timeout=timeout, pin=pin)
+    except ReplicaGoneError:
+        if entry is None:
+            raise  # not journaled (no explicit budget / evicted): 503
+        journal.replay(handle, entry)
+        return _poll_redirected(journal, handle, entry, cursor,
+                                timeout=timeout), pin or ""
+    toks = list(result.get("tokens") or [])
+    done = bool(result.get("done"))
+    if entry is not None:
+        journal.record_progress(entry, toks, done)
+    return {"tokens": toks[cursor:], "done": done}, tag
+
+
+def _poll_redirected(journal: RequestJournal, handle, entry: JournalEntry,
+                     cursor: int, *, timeout: float = 300.0,
+                     _depth: int = 0) -> Dict[str, Any]:
+    """Serve a poll for a replayed (or journal-complete) stream: stitch
+    ``journal prefix + continuation`` into the client-visible token list."""
+    with entry.lock:
+        redirect = entry.redirect
+        toks = list(entry.tokens)
+    if redirect is None:
+        # no continuation stream: the journal holds the whole delivery
+        return {"tokens": toks[cursor:], "done": True}
+    new_pin, new_rid, offset = redirect
+    body = json.dumps({"action": "poll", "request_id": new_rid,
+                       "cursor": 0}).encode()
+    try:
+        result, _tag = handle.call_http_sync_tagged(
+            body, timeout=timeout, pin=new_pin)
+    except ReplicaGoneError:
+        # the replacement died too — replay again from the journal prefix
+        if _depth >= 3:
+            raise
+        with entry.lock:
+            if entry.redirect == redirect:
+                entry.redirect = None
+        journal.replay(handle, entry)
+        return _poll_redirected(journal, handle, entry, cursor,
+                                timeout=timeout, _depth=_depth + 1)
+    new_toks = list(result.get("tokens") or [])
+    done = bool(result.get("done"))
+    with entry.lock:
+        full = list(entry.tokens[:offset]) + new_toks
+        entry.tokens = full
+        entry.done = done
+    return {"tokens": full[cursor:], "done": done}

@@ -16,6 +16,7 @@ import shutil
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tpu_air.faults import plan as _faults
 from tpu_air.observability import tracing as _tracing
 
 from .checkpoint import Checkpoint
@@ -61,6 +62,14 @@ class Session:
     # -- reporting ---------------------------------------------------------
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
         self._iter += 1
+        if _faults.enabled():
+            # deterministic chaos (docs/RESILIENCE.md): a "kill" here takes
+            # the whole trial actor down BEFORE this report's checkpoint is
+            # retained — exactly the crash FailureConfig recovery must
+            # survive by resuming from the previous retained checkpoint
+            spec = _faults.perturb("train.report", key=str(self._iter))
+            if spec is not None and spec.action == "kill":
+                os._exit(1)
         rec = dict(metrics)
         rec.setdefault("training_iteration", self._iter)
         rec.setdefault("_timestamp", time.time())

@@ -39,6 +39,24 @@ def _default_storage() -> str:
     )
 
 
+def _scan_latest_checkpoint(run_dir: str):
+    """Newest ``checkpoint_*`` directory under ``run_dir`` as the
+    ``(path, metrics)`` pair the session would have reported.  The recovery
+    source when the trial ACTOR crashed: its in-memory checkpoint list died
+    with it, but the retained directories are durable (the iteration-numbered
+    names sort chronologically)."""
+    try:
+        dirs = sorted(
+            d for d in os.listdir(run_dir)
+            if d.startswith("checkpoint_")
+            and os.path.isdir(os.path.join(run_dir, d)))
+    except OSError:
+        return None
+    if not dirs:
+        return None
+    return (os.path.join(run_dir, dirs[-1]), {})
+
+
 @tpu_air.remote
 class _TrialRunner:
     """Actor hosting one training run on its chip lease."""
@@ -246,8 +264,11 @@ class BaseTrainer:
                 )
                 err = out.get("error")
             except tpu_air.RemoteError as e:  # actor crashed outright
+                # the crash took the session's in-memory checkpoint list with
+                # it — recover the newest on-disk checkpoint so the retry
+                # RESUMES instead of silently restarting from scratch
                 out = {"history": [], "checkpoints": [], "best_checkpoint": None,
-                       "latest_checkpoint": None}
+                       "latest_checkpoint": _scan_latest_checkpoint(run_dir)}
                 err = str(e)
             finally:
                 tpu_air.kill(runner)
