@@ -26,6 +26,14 @@ interactive self-load, is what must not move interactive latency.
   (the ``interactive_p99_ratio`` headline; tests/test_serve_slo.py
   asserts ≤1.2x with a CPU-noise floor).
 
+A third **swap** phase measures the live weight hot-swap path
+(tpu_air/serve/weights.py): underload-rate traffic runs while a
+WeightsController publishes + canary-promotes the SAME weights across
+the fleet mid-phase.  Headlines: ``swap_stall_ms`` — the worst decode
+gap any replica's swap introduced (fleet-merged
+``tpu_air_weights_swap_stall_ms_max``) — and ``swap_errors_total``,
+which must stay 0 (a swap drops no streams).
+
 Reported per phase and class: arrivals, completed, shed (proxy 503s and
 engine-side overload look identical to the client), proxy-side
 queued/shed counter deltas, TTFT p50/p99 both CLIENT-observed (includes
@@ -384,6 +392,50 @@ def main():
             result[name] = _run_phase(args.interactive_rps, bg_rate,
                                       args.duration, prompts, args.max_new,
                                       rng)
+
+        # -- swap phase: live hot-swap under streaming load ---------------
+        import tempfile
+
+        from tpu_air.engine.metrics import merge_snapshots
+        from tpu_air.serve import WeightsController, WeightStore
+        from tpu_air.serve.proxy import replica_engine_stats
+        from tpu_air.serve.weights import compute_probe
+
+        h = serve.run(
+            EngineDeployment.options(
+                name="bench-engine", route_prefix="/engine"
+            ).bind(ckpt, engine_cfg),
+            port=PORT,
+            admission_policy=policy,
+        )
+        _post("/engine", {"prompt": prompts[0], "priority": "batch",
+                          "max_new_tokens": args.max_new}, timeout=300.0)
+        store = WeightStore(tempfile.mkdtemp(prefix="bench-wstore-"))
+        store.publish(
+            params, metadata={"bench": True},
+            probe=compute_probe(model, params, prompts[:2], max_new=4))
+        ctl = WeightsController(h, store.root, probe_prompts=prompts[:2],
+                                probe_max_new=4, soak_s=0.3)
+        promote_out = {}
+
+        def _promote():
+            # fire mid-phase so the swap lands under live decode traffic
+            time.sleep(args.duration / 3.0)
+            promote_out.update(ctl.promote())
+
+        th = threading.Thread(target=_promote, daemon=True)
+        th.start()
+        result["swap"] = _run_phase(args.interactive_rps,
+                                    args.underload_rps, args.duration,
+                                    prompts, args.max_new, rng)
+        th.join(timeout=120.0)
+        merged_w = (merge_snapshots(replica_engine_stats())
+                    if replica_engine_stats() else {}).get("weights") or {}
+        result["swap"]["promote"] = promote_out
+        result["swap_stall_ms"] = round(
+            float(merged_w.get("max_stall_ms", 0.0)), 3)
+        result["swap_errors_total"] = sum(
+            c["errors"] for c in result["swap"]["classes"].values())
 
         under = result["underload"]["classes"]["interactive"]
         over = result["overload"]["classes"]["interactive"]

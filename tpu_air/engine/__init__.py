@@ -29,6 +29,7 @@ from .types import (
     EngineConfig,
     EngineOverloadedError,
     Request,
+    RequestValidationError,
     ResponseStream,
 )
 
@@ -48,6 +49,7 @@ __all__ = [
     "PrefixCache",
     "PrefixMatch",
     "Request",
+    "RequestValidationError",
     "ShardedPagedPool",
     "ResponseStream",
     "Scheduler",

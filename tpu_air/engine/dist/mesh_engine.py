@@ -60,6 +60,10 @@ class MeshEngine(InferenceEngine):
         cfg = config or EngineConfig()
         if cfg.kv_mode != "paged":
             raise ValueError("MeshEngine requires kv_mode='paged'")
+        if cfg.adapter_slots > 0:
+            raise ValueError(
+                "adapter_slots is single-chip-only for now: the sharded "
+                "decode step has no bank shardings (see docs/SERVING.md)")
         if cfg.num_slots % dp != 0:
             raise ValueError(
                 f"num_slots {cfg.num_slots} not divisible by dp {dp}")

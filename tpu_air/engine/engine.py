@@ -55,6 +55,7 @@ from tpu_air.models.lm.generate import (
     make_page_copy_fn,
 )
 
+from tpu_air.faults import plan as _faults
 from tpu_air.observability import tracing as _tracing
 from tpu_air.observability import perf as _perf
 
@@ -69,6 +70,7 @@ from .types import (
     EngineDrainingError,
     EngineOverloadedError,
     Request,
+    RequestValidationError,
     ResponseStream,
 )
 
@@ -103,6 +105,10 @@ class InferenceEngine:
         if cfg.kv_mode not in ("paged", "slab"):
             raise ValueError(f"unknown kv_mode {cfg.kv_mode!r}")
         self.paged = cfg.kv_mode == "paged"
+        self.adapters_enabled = cfg.adapter_slots > 0
+        if self.adapters_enabled and not self.paged:
+            raise ValueError(
+                "adapter_slots requires the paged engine (kv_mode='paged')")
 
         # device side: the persistent donated KV pool + compiled phases
         # (subclasses override the builders — MeshEngine swaps in a sharded
@@ -138,6 +144,22 @@ class InferenceEngine:
             self._cost_model = None
             self._decode_cost = None
 
+        # live-weight swap state (serve/weights.py): the version currently
+        # serving plus the PRIOR device tree — rollback never touches the
+        # store, so it survives a corrupted/GC'd publish.  Doubles weight
+        # memory while a prior version is retained — the price of instant
+        # rollback, documented in docs/SERVING.md.
+        self._weights_version: Optional[int] = None
+        self._prev_params: Any = None
+        self._prev_version: Optional[int] = None
+
+        # multi-tenant LoRA: name -> bank row map (row 0 = zero adapter)
+        # and the host per-slot row table the decode step gathers from.
+        # Lock order: _step_lock OUTER, _adapter_lock inner.
+        self._adapter_rows: Dict[str, int] = {}
+        self._adapter_lock = threading.Lock()
+        self._adapter_ids_host = np.zeros((cfg.num_slots,), np.int32)
+
         self._next_request_id = 0
         self._id_lock = threading.Lock()
         self._step_lock = threading.Lock()
@@ -160,10 +182,19 @@ class InferenceEngine:
             cfg.pages_per_slot(),
         )
         self._decode_step = make_lm_paged_decode_step_fn(
-            self.model, cfg.slot_len)
+            self.model, cfg.slot_len, adapters=self.adapters_enabled)
         self._chunk_fn = make_lm_prefill_chunk_fn(
-            self.model, cfg.page_len, cfg.slot_len)
+            self.model, cfg.page_len, cfg.slot_len,
+            adapters=self.adapters_enabled)
         self._copy_fn = make_page_copy_fn()
+        if self.adapters_enabled:
+            mc = self.model.config
+            A, r = cfg.adapter_slots, cfg.adapter_rank
+            # resident LoRA bank: row 0 is the pinned zero adapter, so
+            # base-model slots gather an exact-zero delta (greedy parity)
+            self._adapter_a = jnp.zeros((A + 1, mc.d_model, r), jnp.float32)
+            self._adapter_b = jnp.zeros((A + 1, r, mc.vocab_size),
+                                        jnp.float32)
 
     def _build_slab_state(self) -> None:
         cfg = self.config
@@ -177,7 +208,8 @@ class InferenceEngine:
     def _make_request(self, prompt, max_new_tokens, stream,
                       priority: str = "interactive", *,
                       admit_while_draining: bool = False,
-                      deadline_ms: Optional[float] = None) -> Request:
+                      deadline_ms: Optional[float] = None,
+                      adapter_id: Optional[str] = None) -> Request:
         """Shared validation + Request construction for both submit paths.
 
         ``admit_while_draining`` is the disaggregated-handoff escape hatch:
@@ -209,6 +241,18 @@ class InferenceEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens ({budget}) "
                 f"exceeds slot_len ({self.config.slot_len})"
             )
+        if adapter_id is not None:
+            # fail fast at submit (the proxy maps RequestValidationError to
+            # HTTP 400, unlike a plain replica-side ValueError which stays
+            # 500); admission re-resolves — the adapter may be evicted
+            # meanwhile
+            if not self.adapters_enabled:
+                raise RequestValidationError(
+                    "adapter_id requires EngineConfig.adapter_slots > 0")
+            with self._adapter_lock:
+                if adapter_id not in self._adapter_rows:
+                    raise RequestValidationError(
+                        f"unknown adapter {adapter_id!r}")
         with self._id_lock:
             rid = self._next_request_id
             self._next_request_id += 1
@@ -217,7 +261,8 @@ class InferenceEngine:
                        else ResponseStream(rid),
                        priority=priority,
                        deadline_ms=(None if deadline_ms is None
-                                    else float(deadline_ms)))
+                                    else float(deadline_ms)),
+                       adapter_id=adapter_id)
 
     def _enqueue(self, req: Request) -> ResponseStream:
         try:
@@ -232,7 +277,8 @@ class InferenceEngine:
                max_new_tokens: Optional[int] = None, *,
                priority: str = "interactive",
                stream: Optional[ResponseStream] = None,
-               deadline_ms: Optional[float] = None) -> ResponseStream:
+               deadline_ms: Optional[float] = None,
+               adapter_id: Optional[str] = None) -> ResponseStream:
         """Queue one prompt; returns its token stream immediately.
 
         ``priority`` is the request's SLO class (``types.PRIORITIES``):
@@ -244,10 +290,13 @@ class InferenceEngine:
         the request's ABSOLUTE end-to-end deadline (unix-epoch ms): still
         queued past it, the request expires with
         :class:`~tpu_air.faults.retry.DeadlineExceededError` instead of
-        occupying a slot it can no longer use."""
+        occupying a slot it can no longer use.  ``adapter_id`` selects the
+        tenant LoRA adapter the request decodes under (None = base model;
+        unknown/unloaded names raise ValueError here)."""
         return self._enqueue(self._make_request(prompt, max_new_tokens,
                                                 stream, priority,
-                                                deadline_ms=deadline_ms))
+                                                deadline_ms=deadline_ms,
+                                                adapter_id=adapter_id))
 
     def submit_prefilled(self, prompt: Sequence[int], first_token: int,
                          kv_pages: Dict[str, Any],
@@ -398,8 +447,11 @@ class InferenceEngine:
         chunked prefill quantum (no first token yet — TTFT lands when the
         final chunk runs).  A request carrying shipped KV pages skips the
         chunk phase entirely (prefill already ran on another replica)."""
+        if not self._resolve_adapter(req):
+            return
         slot = self.slots.acquire()
         slot.request = req
+        self._adapter_ids_host[slot.index] = req.adapter_row
         if req.prefilled is not None:
             self._admit_prefilled(slot, req)
             return
@@ -488,10 +540,18 @@ class InferenceEngine:
         last_local = (n - 1 - p0) if is_last else (C - 1)
         row = self.pool.chunk_row(slot.index, p0, plan.null_target)
         t0 = time.monotonic()
-        self.cache, tok = self._chunk_fn(
-            self.params, self.cache, jnp.asarray(ids), jnp.int32(p0),
-            jnp.int32(last_local), jnp.asarray(row),
-        )
+        if self.adapters_enabled:
+            self.cache, tok = self._chunk_fn(
+                self.params, self.cache, jnp.asarray(ids), jnp.int32(p0),
+                jnp.int32(last_local), jnp.asarray(row),
+                self._adapter_a, self._adapter_b,
+                jnp.int32(req.adapter_row),
+            )
+        else:
+            self.cache, tok = self._chunk_fn(
+                self.params, self.cache, jnp.asarray(ids), jnp.int32(p0),
+                jnp.int32(last_local), jnp.asarray(row),
+            )
         if self._cost_model is not None:
             # dispatch-time measurement: only the final chunk is host-synced
             # (int(tok) below), so mid-prompt chunk seconds are the dispatch
@@ -568,6 +628,175 @@ class InferenceEngine:
         ):
             self._retire(slot)
 
+    # -- live weight swap (serve/weights.py) ---------------------------------
+    def swap_params(self, new_params, *, version: Optional[int] = None
+                    ) -> float:
+        """Replace the serving weights BETWEEN decode steps: taken under
+        ``_step_lock``, so no step is mid-flight — slots, host token/pos
+        arrays and the paged pool are untouched, and in-flight streams
+        continue on the new weights at their exact positions.  The new
+        tree is resharded leaf-by-leaf onto the OLD leaves' shardings
+        (``device_put`` per leaf — a tp/dp-partitioned checkpoint restores
+        onto whatever mesh this engine serves on) after a structure/shape
+        check that rejects mismatched trees before touching ``params``.
+
+        Keeps the prior device tree for :meth:`rollback_params` and
+        returns the swap's stall in milliseconds (request-to-done wall
+        time: lock wait + reshard + transfer — the bound on the decode
+        step gap the swap introduced)."""
+        import jax
+
+        t_req = time.monotonic()
+        if _faults.enabled():
+            _faults.perturb("weights.swap", key=self.name)
+        with self._step_lock:
+            old_leaves, old_tree = jax.tree_util.tree_flatten(self.params)
+            new_leaves, new_tree = jax.tree_util.tree_flatten(new_params)
+            if old_tree != new_tree:
+                raise ValueError(
+                    "weight swap rejected: parameter tree structure differs "
+                    "from the serving model")
+            placed = []
+            for o, n in zip(old_leaves, new_leaves):
+                arr = np.asarray(n)
+                if tuple(arr.shape) != tuple(o.shape):
+                    raise ValueError(
+                        f"weight swap rejected: leaf shape {arr.shape} != "
+                        f"serving shape {tuple(o.shape)}")
+                placed.append(jax.device_put(arr.astype(o.dtype), o.sharding))
+            for p in placed:
+                p.block_until_ready()
+            self._prev_params = self.params
+            self._prev_version = self._weights_version
+            self.params = jax.tree_util.tree_unflatten(new_tree, placed)
+            self._weights_version = version
+            stall_ms = (time.monotonic() - t_req) * 1000.0
+        self.metrics.record_weights_swap(version, stall_ms)
+        return stall_ms
+
+    def rollback_params(self) -> float:
+        """Restore the weights :meth:`swap_params` replaced — a pure
+        device-tree pointer swap under ``_step_lock``, no store reads, so
+        rollback works even when the bad publish's store objects are
+        corrupt or already GC'd.  Raises RuntimeError with no prior
+        version retained."""
+        t_req = time.monotonic()
+        with self._step_lock:
+            if self._prev_params is None:
+                raise RuntimeError("no prior weights retained to roll back to")
+            # one-shot: clearing the slot frees the bad tree's device memory
+            # and makes a second rollback (nothing to restore) an error
+            self.params, self._prev_params = self._prev_params, None
+            self._weights_version, self._prev_version = (
+                self._prev_version, None)
+            version = self._weights_version
+            stall_ms = (time.monotonic() - t_req) * 1000.0
+        self.metrics.record_weights_swap(version, stall_ms, rollback=True)
+        return stall_ms
+
+    def weights_version(self) -> Optional[int]:
+        # airlint: disable=CC001 — GIL-atomic pointer read for stats; a
+        # reader racing a swap sees the old or new version, both valid,
+        # and taking _step_lock here would stall stats behind a decode
+        return self._weights_version
+
+    # -- multi-tenant LoRA adapters ------------------------------------------
+    def _resolve_adapter(self, req: Request) -> bool:
+        """Admission-time resolution of ``req.adapter_id`` to a bank row.
+        Submit already validated the name, but the adapter may have been
+        evicted while the request sat queued — then the stream fails
+        loudly (the proxy surfaces the error) instead of silently serving
+        base-model tokens under the tenant's name."""
+        if req.adapter_id is None:
+            req.adapter_row = 0
+            return True
+        with self._adapter_lock:
+            row = self._adapter_rows.get(req.adapter_id)
+        if row is None:
+            req.stream._finish(RequestValidationError(
+                f"adapter {req.adapter_id!r} was evicted while request "
+                f"{req.request_id} was queued"))
+            return False
+        req.adapter_row = row
+        return True
+
+    def load_adapter(self, name: str, a, b) -> int:
+        """Load (or reload in place) tenant ``name``'s LoRA head delta
+        ``logits += (h @ a) @ b`` into a free bank row.  ``a``: [d_model,
+        r], ``b``: [r, vocab]; rank r <= ``adapter_rank`` zero-pads into
+        the bank (zero padding is exact — padded lanes contribute 0).
+        A cheap sub-swap: two ``.at[row].set`` writes under ``_step_lock``
+        between decode steps; the jitted step never retraces."""
+        if not self.adapters_enabled:
+            raise ValueError(
+                "adapters not enabled (EngineConfig.adapter_slots=0)")
+        mc = self.model.config
+        cfg = self.config
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+            raise ValueError(
+                f"adapter shapes must be [d,r] x [r,V], got {a.shape} "
+                f"x {b.shape}")
+        if a.shape[0] != mc.d_model or b.shape[1] != mc.vocab_size:
+            raise ValueError(
+                f"adapter {a.shape} x {b.shape} does not fit model "
+                f"[d={mc.d_model}, V={mc.vocab_size}]")
+        r = a.shape[1]
+        if r > cfg.adapter_rank:
+            raise ValueError(
+                f"adapter rank {r} exceeds bank rank {cfg.adapter_rank}")
+        pa = np.zeros((mc.d_model, cfg.adapter_rank), np.float32)
+        pb = np.zeros((cfg.adapter_rank, mc.vocab_size), np.float32)
+        pa[:, :r] = a
+        pb[:r, :] = b
+        with self._step_lock:
+            with self._adapter_lock:
+                row = self._adapter_rows.get(name)
+                if row is None:
+                    used = set(self._adapter_rows.values())
+                    free = [i for i in range(1, cfg.adapter_slots + 1)
+                            if i not in used]
+                    if not free:
+                        raise ValueError(
+                            f"adapter bank full ({cfg.adapter_slots} rows); "
+                            f"unload a tenant first")
+                    row = free[0]
+                    self._adapter_rows[name] = row
+                n_loaded = len(self._adapter_rows)
+            self._adapter_a = self._adapter_a.at[row].set(jnp.asarray(pa))
+            self._adapter_b = self._adapter_b.at[row].set(jnp.asarray(pb))
+        self.metrics.set_adapters_loaded(n_loaded)
+        return row
+
+    def unload_adapter(self, name: str) -> bool:
+        """Evict tenant ``name``: zero its bank row and free it.  Refuses
+        (RuntimeError) while any active slot decodes under the row —
+        eviction must not change tokens mid-stream."""
+        if not self.adapters_enabled:
+            return False
+        with self._step_lock:
+            with self._adapter_lock:
+                row = self._adapter_rows.get(name)
+                if row is None:
+                    return False
+                if any(self._adapter_ids_host[s.index] == row
+                       for s in self.slots.active_slots()):
+                    raise RuntimeError(
+                        f"adapter {name!r} is serving active slots; drain "
+                        f"them before unloading")
+                del self._adapter_rows[name]
+                n_loaded = len(self._adapter_rows)
+            self._adapter_a = self._adapter_a.at[row].set(0.0)
+            self._adapter_b = self._adapter_b.at[row].set(0.0)
+        self.metrics.set_adapters_loaded(n_loaded)
+        return True
+
+    def adapters(self) -> Dict[str, int]:
+        """Loaded tenant adapters: name -> bank row."""
+        with self._adapter_lock:
+            return dict(self._adapter_rows)
+
     # -- decode --------------------------------------------------------------
     def _null_entry(self, slot_index: int) -> int:
         """The page id a non-decoding slot's table row is masked with.  The
@@ -586,11 +815,22 @@ class InferenceEngine:
             for s in self.slots.slots:
                 if not s.active or s.prefilling:
                     table[s.index] = self._null_entry(s.index)
-            self.cache, nxt = self._decode_step(
-                self.params, self.cache,
-                jnp.asarray(self._cur_tok), jnp.asarray(self._pos),
-                jnp.asarray(table),
-            )
+            if self.adapters_enabled:
+                # per-slot LoRA rows gathered the way the table is: one
+                # host array in, no retrace, row 0 = exact-zero delta
+                self.cache, nxt = self._decode_step(
+                    self.params, self.cache,
+                    jnp.asarray(self._cur_tok), jnp.asarray(self._pos),
+                    jnp.asarray(table),
+                    self._adapter_a, self._adapter_b,
+                    jnp.asarray(self._adapter_ids_host),
+                )
+            else:
+                self.cache, nxt = self._decode_step(
+                    self.params, self.cache,
+                    jnp.asarray(self._cur_tok), jnp.asarray(self._pos),
+                    jnp.asarray(table),
+                )
         else:
             self.cache, nxt = self._decode_step(
                 self.params, self.cache,
@@ -636,6 +876,7 @@ class InferenceEngine:
         self.slots.release(slot)
         self._cur_tok[slot.index] = 0
         self._pos[slot.index] = 0
+        self._adapter_ids_host[slot.index] = 0
 
     def _emit_request_spans(self, slot: Slot) -> None:
         """Retirement-time airtrace emission: the request's whole span tree

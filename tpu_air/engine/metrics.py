@@ -85,6 +85,9 @@ class EngineMetrics:
         self.prefill_chunks = 0
         # mesh/lease/role metadata (empty for single-chip engines)
         self.topology: Dict[str, Any] = {}
+        # live-weight state (empty until the first swap/adapter load —
+        # snapshot shape unchanged for engines that never hot-swap)
+        self.weights: Dict[str, Any] = {}
         register(self)
 
     def set_topology(self, **kw: Any) -> None:
@@ -167,6 +170,27 @@ class EngineMetrics:
         with self._lock:
             self.ledger.record_program(kind, cost, seconds)
 
+    def record_weights_swap(self, version: Optional[int], stall_ms: float,
+                            rollback: bool = False) -> None:
+        """One live weight swap on this engine: the version now serving,
+        and the decode-step gap it cost (lock wait + reshard + device_put
+        — the honest ``swap_stall_ms`` the bench gates on).  ``rollback``
+        marks swaps that restored the prior version."""
+        with self._lock:
+            w = self.weights
+            if version is not None:
+                w["version"] = int(version)
+            w["swaps"] = int(w.get("swaps", 0)) + 1
+            if rollback:
+                w["rollbacks"] = int(w.get("rollbacks", 0)) + 1
+            w["last_stall_ms"] = float(stall_ms)
+            w["max_stall_ms"] = max(float(stall_ms),
+                                    float(w.get("max_stall_ms", 0.0)))
+
+    def set_adapters_loaded(self, n: int) -> None:
+        with self._lock:
+            self.weights["adapters_loaded"] = int(n)
+
     def record_goodput(self, category: str, n: int) -> None:
         """Ledger feed: ``n`` tokens attributed to ``category`` ("useful"
         or a wasted class — perf.WASTED_CATEGORIES)."""
@@ -233,6 +257,8 @@ class EngineMetrics:
                 out["prefill_chunks"] = self.prefill_chunks
             if self.topology:
                 out["topology"] = dict(self.topology)
+            if self.weights:
+                out["weights"] = dict(self.weights)
         out["tokens_per_s"] = self.tokens_per_s()
         return out
 
@@ -293,6 +319,20 @@ def merge_snapshots(snapshots: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     perfs = [s.get("perf") for s in snaps if s.get("perf")]
     if perfs:
         out["perf"] = merge_ledger_snapshots(perfs)
+    ws = [s.get("weights") for s in snaps if s.get("weights")]
+    if ws:
+        # fleet view: swaps/rollbacks sum, the serving version is the max
+        # (mid-promotion the fleet is legitimately mixed), stall is the
+        # worst replica's worst swap — the number bench_serve headlines
+        out["weights"] = {
+            "version": max(int(w.get("version", 0)) for w in ws),
+            "swaps": sum(int(w.get("swaps", 0)) for w in ws),
+            "rollbacks": sum(int(w.get("rollbacks", 0)) for w in ws),
+            "max_stall_ms": max(float(w.get("max_stall_ms", 0.0))
+                                for w in ws),
+            "adapters_loaded": sum(int(w.get("adapters_loaded", 0))
+                                   for w in ws),
+        }
     return out
 
 
@@ -354,6 +394,18 @@ _FAMILIES = [
      "tokens retired on streams that completed normally"),
     ("tpu_air_engine_tokens_wasted", "counter",
      "tokens whose work was wasted, by category"),
+    # live-weight plane (serve/weights.py): absent until an engine swaps
+    ("tpu_air_weights_version", "gauge",
+     "weight-store version currently serving"),
+    ("tpu_air_weights_swaps", "counter", "live weight swaps applied"),
+    ("tpu_air_weights_rollbacks", "counter",
+     "swaps that restored the prior version (canary gate failures)"),
+    ("tpu_air_weights_swap_stall_ms", "gauge",
+     "decode-step gap of the most recent swap, milliseconds"),
+    ("tpu_air_weights_swap_stall_ms_max", "gauge",
+     "worst decode-step gap across all swaps, milliseconds"),
+    ("tpu_air_weights_adapters_loaded", "gauge",
+     "tenant LoRA adapters resident in the bank"),
 ]
 
 
@@ -470,6 +522,23 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
                 b.raw("tpu_air_engine_tokens_wasted",
                       f"tpu_air_engine_tokens_wasted"
                       f'{{engine="{label}",category="{cat}"}} {n}')
+        # live-weight plane gauges (absent on engines that never swapped)
+        w = snap.get("weights") or {}
+        for skey, fam in (("version", "tpu_air_weights_version"),
+                          ("swaps", "tpu_air_weights_swaps"),
+                          ("rollbacks", "tpu_air_weights_rollbacks"),
+                          ("adapters_loaded",
+                           "tpu_air_weights_adapters_loaded")):
+            if skey in w:
+                b.raw(fam, f"{fam}{tag} {int(w[skey])}")
+        if "last_stall_ms" in w:
+            b.raw("tpu_air_weights_swap_stall_ms",
+                  f"tpu_air_weights_swap_stall_ms{tag} "
+                  f"{float(w['last_stall_ms']):.3f}")
+        if "max_stall_ms" in w:
+            b.raw("tpu_air_weights_swap_stall_ms_max",
+                  f"tpu_air_weights_swap_stall_ms_max{tag} "
+                  f"{float(w['max_stall_ms']):.3f}")
         # topology: strings fold into one info line's labels, numbers
         # (replica counts, device counts) become gauges
         topo = snap.get("topology") or {}

@@ -42,6 +42,15 @@ class EngineClosedError(TpuAirError):
     """The engine was shut down with this request still queued/in flight."""
 
 
+class RequestValidationError(ValueError, TpuAirError):
+    """The request itself is malformed (unknown ``adapter_id``): the
+    client's fault, not the server's.  A ValueError subclass so local
+    callers can keep catching ValueError, but distinct across the actor
+    boundary — the proxy maps THIS name to HTTP 400 while an application
+    ValueError raised inside a replica stays a 500 (it signals a server
+    bug, and must not be retried as if resubmitting could fix it)."""
+
+
 @dataclass
 class EngineConfig:
     """Dials for the KV pool and admission policy.
@@ -94,6 +103,15 @@ class EngineConfig:
     * ``eos_token_id`` — ``"model"`` (default): use the model config's
       ``eos_token_id``; ``None``: never early-stop (budget-only
       retirement); an int: that id.
+    * ``adapter_slots`` — multi-tenant LoRA: rows in the resident adapter
+      bank (0 disables adapters; paged single-chip engines only).  Row 0
+      is the pinned zero adapter, so the bank holds ``adapter_slots``
+      loadable tenants on top of it.  Per-request selection rides
+      ``Request.adapter_id``; the decode step gathers each slot's delta
+      the way it gathers the block table.
+    * ``adapter_rank`` — LoRA rank r of the bank rows ``[d, r] x [r, V]``.
+      Lower-rank adapters zero-pad into the bank; higher ranks are
+      rejected at load.
     """
 
     num_slots: int = 8
@@ -110,6 +128,8 @@ class EngineConfig:
     queue_shares: Optional[dict] = None
     prefill_buckets: Optional[Tuple[int, ...]] = None
     eos_token_id: Union[int, None, str] = "model"
+    adapter_slots: int = 0
+    adapter_rank: int = 4
 
     _DEFAULT_QUEUE_SHARES = {
         "interactive": 1.0, "batch": 0.85, "best_effort": 0.5,
@@ -237,3 +257,10 @@ class Request:
     # still-queued requests past it (DeadlineExceededError → HTTP 504)
     # rather than letting them occupy a slot they can no longer use.
     deadline_ms: Optional[float] = None
+    # multi-tenant LoRA: the tenant adapter this request decodes under
+    # (None = base model).  Validated against the loaded-adapter table at
+    # submit (fail fast) AND re-resolved at admission (the adapter may
+    # have been evicted while the request sat queued); ``adapter_row`` is
+    # the resolved bank row the slot gathers each step (0 = zero adapter).
+    adapter_id: Optional[str] = None
+    adapter_row: int = 0

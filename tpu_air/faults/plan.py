@@ -33,6 +33,12 @@ site                  actions
 ``proxy.request``     ``delay``
 ``proxy.poll``        ``delay``, ``kill`` (crash the pinned replica)
 ``train.report``      ``delay``, ``kill`` (os._exit mid-run)
+``weights.publish``   ``kill`` (torn publish: shards land, the manifest
+                      never does), ``corrupt`` (bad tensor VALUES with
+                      valid checksums — the canary gate's quarry),
+                      ``delay`` (stall before the manifest write)
+``weights.swap``      ``delay``, ``error`` (the swap RPC fails on the
+                      target replica)
 ====================  ==========================================
 
 This module is pure stdlib and imports nothing from ``tpu_air`` — it sits
@@ -143,6 +149,11 @@ class FaultPlan:
                 delay_s=round(rng.uniform(0.01, 0.1), 3)),
             "train.report": lambda: FaultSpec(
                 "train.report", "kill", at=rng.randint(2, 4)),
+            "weights.publish": lambda: FaultSpec(
+                "weights.publish", "corrupt", at=rng.randint(1, 6)),
+            "weights.swap": lambda: FaultSpec(
+                "weights.swap", "delay", at=rng.randint(1, 3),
+                delay_s=round(rng.uniform(0.01, 0.1), 3)),
         }
         chosen = sites if sites is not None else sorted(templates)
         specs = []

@@ -322,17 +322,27 @@ def init_paged_cache(model: CausalLM, num_slots: int, num_pages: int,
     return rebuild(base)
 
 
-def make_paged_decode_body(model: CausalLM, slot_len: int):
+def make_paged_decode_body(model: CausalLM, slot_len: int,
+                           adapters: bool = False):
     """The UNJITTED paged decode step body: ``fn(params, cache, tok, pos,
     block_table) -> (cache', next_tok)``.  Both the single-chip factory
     below and the sharded factory (engine/dist/sharded.py, which adds
     pjit in/out shardings over a ``(data, model)`` mesh) wrap this same
     body — parity between the two engines is parity of jit options, not
-    of two step implementations."""
+    of two step implementations.
+
+    With ``adapters=True`` the signature grows three trailing args —
+    ``bank_a [A+1, d, r]``, ``bank_b [A+1, r, V]``, ``adapter_ids [S]``
+    — and each slot's head logits get a per-slot LoRA delta
+    ``(h @ bank_a[id]) @ bank_b[id]`` gathered exactly the way the block
+    table gathers pages: one dynamic-gather per step, no per-tenant
+    retrace.  Bank row 0 is the zero adapter, so slots with id 0 compute
+    an exact-zero delta and stay bit-identical to the base model."""
     cfg = model.config
     dcfg = {**cfg.to_dict(), "max_seq_len": slot_len}
 
-    def step(params, cache, tok, pos, block_table):
+    def step(params, cache, tok, pos, block_table,
+             bank_a=None, bank_b=None, adapter_ids=None):
         dmodel = CausalLM(LMConfig.from_dict(dcfg))
         pos = pos.astype(jnp.int32)
         cache = _map_cache_index(cache, lambda _: pos)
@@ -344,35 +354,55 @@ def make_paged_decode_body(model: CausalLM, slot_len: int):
             decode=True, return_hidden=True, mutable=["cache"],
         )
         head_w = head_weight(params, cfg).astype(jnp.float32)
-        nxt = jnp.argmax(
-            hidden[:, -1].astype(jnp.float32) @ head_w, axis=-1
-        ).astype(jnp.int32)
+        h = hidden[:, -1].astype(jnp.float32)
+        logits = h @ head_w
+        if adapters:
+            a = bank_a[adapter_ids]                      # [S, d, r]
+            b = bank_b[adapter_ids]                      # [S, r, V]
+            logits = logits + jnp.einsum(
+                "sr,srv->sv", jnp.einsum("sd,sdr->sr", h, a), b)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return vars_["cache"], nxt
 
+    if not adapters:
+        def base_step(params, cache, tok, pos, block_table):
+            return step(params, cache, tok, pos, block_table)
+        return base_step
     return step
 
 
-def make_lm_paged_decode_step_fn(model: CausalLM, slot_len: int):
+def make_lm_paged_decode_step_fn(model: CausalLM, slot_len: int,
+                                 adapters: bool = False):
     """The persistent paged engine step: jitted ``fn(params, cache, tok,
     pos, block_table) -> (cache', next_tok)``, cache donated.  Identical
     contract to :func:`make_lm_decode_step_fn` plus the block table
     ``[S, pages_per_slot]`` int32 (the host pool's authoritative table —
     rows of non-decoding slots pointed at the null page so their ride-along
-    scatter can't touch a live or prefix-shared page)."""
-    return jax.jit(make_paged_decode_body(model, slot_len),
+    scatter can't touch a live or prefix-shared page).  ``adapters=True``
+    appends the LoRA bank args (see :func:`make_paged_decode_body`); the
+    banks are NOT donated — they persist across steps like params."""
+    return jax.jit(make_paged_decode_body(model, slot_len, adapters),
                    donate_argnums=(1,))
 
 
-def make_prefill_chunk_body(model: CausalLM, page_len: int, slot_len: int):
+def make_prefill_chunk_body(model: CausalLM, page_len: int, slot_len: int,
+                            adapters: bool = False):
     """The UNJITTED chunked-prefill body: ``fn(params, cache, ids, p0,
     last_local, table_row) -> (cache', tok)`` — shared by the single-chip
     jit wrapper below and the sharded pjit wrapper (engine/dist/sharded.py,
     where ids/p0/last_local/table_row replicate: a chunk is b=1 work, only
-    its page writes land in a data shard)."""
+    its page writes land in a data shard).
+
+    With ``adapters=True`` three trailing args appear — ``bank_a``,
+    ``bank_b`` and a SCALAR ``adapter_id`` (a chunk is one slot's work) —
+    and the final chunk's first greedy token gets the same LoRA head
+    delta as the decode body, so a tenant's stream is adapter-consistent
+    from token 0."""
     cfg = model.config
     dcfg = {**cfg.to_dict(), "max_seq_len": slot_len}
 
-    def prefill_chunk(params, cache, ids, p0, last_local, table_row):
+    def prefill_chunk(params, cache, ids, p0, last_local, table_row,
+                      bank_a=None, bank_b=None, adapter_id=None):
         dmodel = CausalLM(LMConfig.from_dict(dcfg))
         p0 = p0.astype(jnp.int32)
         # leaf shapes must stay [S]/[S, npg] across chunk and decode calls
@@ -389,16 +419,23 @@ def make_prefill_chunk_body(model: CausalLM, page_len: int, slot_len: int):
             decode=True, return_hidden=True, mutable=["cache"],
         )
         head_w = head_weight(params, cfg).astype(jnp.float32)
-        h_last = hidden[0, last_local.astype(jnp.int32)]
-        tok = jnp.argmax(
-            h_last.astype(jnp.float32) @ head_w
-        ).astype(jnp.int32)
+        h_last = hidden[0, last_local.astype(jnp.int32)].astype(jnp.float32)
+        logits = h_last @ head_w
+        if adapters:
+            logits = logits + (h_last @ bank_a[adapter_id]) @ bank_b[adapter_id]
+        tok = jnp.argmax(logits).astype(jnp.int32)
         return vars_["cache"], tok
 
+    if not adapters:
+        def base_chunk(params, cache, ids, p0, last_local, table_row):
+            return prefill_chunk(params, cache, ids, p0, last_local,
+                                 table_row)
+        return base_chunk
     return prefill_chunk
 
 
-def make_lm_prefill_chunk_fn(model: CausalLM, page_len: int, slot_len: int):
+def make_lm_prefill_chunk_fn(model: CausalLM, page_len: int, slot_len: int,
+                             adapters: bool = False):
     """Build THE chunked-prefill unit: a jitted ``fn(params, cache, ids,
     p0, last_local, table_row) -> (cache', tok)``, cache donated.
 
@@ -419,7 +456,8 @@ def make_lm_prefill_chunk_fn(model: CausalLM, page_len: int, slot_len: int):
     Fixed shapes -> ONE compiled program covers every prompt length; the
     engine interleaves these calls between decode steps so long prompts
     stream in without stalling in-flight decodes."""
-    return jax.jit(make_prefill_chunk_body(model, page_len, slot_len),
+    return jax.jit(make_prefill_chunk_body(model, page_len, slot_len,
+                                           adapters),
                    donate_argnums=(1,))
 
 

@@ -26,6 +26,15 @@ from .engine_deployment import EngineDeployment
 from .http_adapters import json_request, pandas_read_json
 from .predictor_deployment import PredictorDeployment
 from .proxy import rollout, run, shutdown, status
+from .weights import (
+    GateFailedError,
+    TornPublishError,
+    WeightsController,
+    WeightsIntegrityError,
+    WeightStore,
+    attach_weights,
+    compute_probe,
+)
 
 __all__ = [
     "AdmissionController",
@@ -37,9 +46,16 @@ __all__ = [
     "Deployment",
     "DeploymentHandle",
     "EngineDeployment",
+    "GateFailedError",
     "NoLiveReplicasError",
     "PredictorDeployment",
     "ReplicaGoneError",
+    "TornPublishError",
+    "WeightStore",
+    "WeightsController",
+    "WeightsIntegrityError",
+    "attach_weights",
+    "compute_probe",
     "deployment",
     "json_request",
     "pandas_read_json",

@@ -153,6 +153,7 @@ class _EngineServer:
                 payload.get("max_new_tokens"),
                 priority=payload.get("priority", "interactive"),
                 deadline_ms=payload.get("deadline_ms"),
+                adapter_id=payload.get("adapter_id"),
             )}
         if action == "poll":
             return self.poll(int(payload.get("request_id", -1)),
@@ -170,6 +171,8 @@ class _EngineServer:
         deadline_ms = payload.get("deadline_ms")
         front = self._front()
         kw = {} if deadline_ms is None else {"deadline_ms": float(deadline_ms)}
+        if payload.get("adapter_id") is not None:
+            kw["adapter_id"] = str(payload["adapter_id"])
         # submit ALL before joining ANY — concurrent prompts share pool steps
         streams = [front.submit(p, max_new, priority=priority, **kw)
                    for p in prompts]
@@ -184,14 +187,24 @@ class _EngineServer:
     # -- streaming path (HTTP actions above, or direct actor RPC) -------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
                priority: str = "interactive",
-               deadline_ms: Optional[float] = None) -> int:
+               deadline_ms: Optional[float] = None,
+               adapter_id: Optional[str] = None) -> int:
         # deadline_ms is absolute unix-epoch ms (the proxy converts the
         # client's relative budget at admission).  Passed through only when
         # set: the T5 window engine doesn't take it, and None means "no
-        # deadline" everywhere.
+        # deadline" everywhere.  Same for adapter_id (multi-tenant LoRA —
+        # paged causal-LM engines only).
         kw = {} if deadline_ms is None else {"deadline_ms": float(deadline_ms)}
-        stream = self._front().submit(prompt, max_new_tokens,
-                                      priority=priority, **kw)
+        front = self._front()
+        if adapter_id is not None:
+            if self._router is not None:
+                from ..engine.types import RequestValidationError
+                raise RequestValidationError(
+                    "adapter_id is not supported with disaggregated "
+                    "serving (prefill workers hold no adapter bank)")
+            kw["adapter_id"] = str(adapter_id)
+        stream = front.submit(prompt, max_new_tokens,
+                              priority=priority, **kw)
         self._streams[stream.request_id] = stream
         return stream.request_id
 
@@ -224,6 +237,62 @@ class _EngineServer:
             if err is not None:
                 raise err
         return {"tokens": toks[cursor:], "done": done}
+
+    # -- live weights (serve/weights.py WeightsController RPCs) ---------------
+    def weights_swap(self, store_root: str,
+                     version: Optional[int] = None) -> float:
+        """Load ``version`` (default: latest) from the weight store —
+        checksum-validated — and hot-swap it into the serving engine
+        between decode steps.  Returns the swap's stall in ms."""
+        from .weights import WeightStore
+
+        engine = self._ensure_engine()
+        store = WeightStore(store_root)
+        if version is None:
+            version = store.latest_version()
+        params = store.load(version)
+        return engine.swap_params(params, version=version)
+
+    def weights_rollback(self) -> float:
+        """Restore the pre-swap weights (engine-held device tree — no
+        store reads, survives a corrupt/GC'd publish)."""
+        return self._ensure_engine().rollback_params()
+
+    def weights_version(self) -> Optional[int]:
+        if self._engine is None:
+            return None
+        return self._engine.weights_version()
+
+    def weights_probe(self, prompts, max_new: int = 8, *,
+                      adapter_id: Optional[str] = None,
+                      timeout_s: float = 60.0) -> list:
+        """Run the canary probe prompts through THIS replica's engine
+        (the full admit/prefill/decode path, not an offline forward) and
+        return their greedy token lists."""
+        engine = self._ensure_engine()
+        kw = {} if adapter_id is None else {"adapter_id": str(adapter_id)}
+        streams = [engine.submit([int(t) for t in p], int(max_new), **kw)
+                   for p in prompts]
+        return [s.result(float(timeout_s)) for s in streams]
+
+    def weights_probe_logits(self, prompts) -> list:
+        """Last-prompt-position logits under the SERVING params (the
+        logit-tolerance gate surface for quantized bases)."""
+        from .weights import probe_logits
+
+        engine = self._ensure_engine()
+        return probe_logits(engine.model, engine.params, prompts)
+
+    def weights_load_adapter(self, name: str, a, b) -> int:
+        return self._ensure_engine().load_adapter(name, a, b)
+
+    def weights_unload_adapter(self, name: str) -> bool:
+        return self._ensure_engine().unload_adapter(name)
+
+    def weights_adapters(self) -> Dict[str, int]:
+        if self._engine is None:
+            return {}
+        return self._engine.adapters()
 
     # -- draining (zero-downtime rollout / scale-down) ------------------------
     def drain(self) -> None:

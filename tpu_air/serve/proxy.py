@@ -276,7 +276,8 @@ class _Handler(BaseHTTPRequestHandler):
                         max_new_tokens=payload["max_new_tokens"],
                         priority=str(
                             payload.get("priority") or "interactive"),
-                        deadline_ms=payload.get("deadline_ms"))
+                        deadline_ms=payload.get("deadline_ms"),
+                        adapter_id=payload.get("adapter_id"))
             self._respond(200, _to_jsonable(result),
                           headers={REPLICA_HEADER: tag})
         except AdmissionShedError as e:
@@ -302,6 +303,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # failed stream) crosses the actor boundary as RemoteError
                 self._respond(504, {"error": e.cause_repr},
                               headers={"Retry-After": "1"})
+            elif e.cause_repr.startswith("RequestValidationError"):
+                # replica-side request validation (unknown adapter_id) is
+                # the client's fault — same 400 the proxy-side ValueError
+                # branch below produces.  Deliberately NOT plain ValueError:
+                # an application ValueError inside a replica is a server
+                # bug and must stay a 500
+                self._respond(400, {"error": e.cause_repr})
             else:
                 self._respond(500, {"error": f"{type(e).__name__}: {e}"})
         except ValueError as e:
@@ -482,6 +490,16 @@ def serve_control_stats() -> Dict[str, Any]:
     # bare key can't collide): journal size, replays, replay failures, and
     # the installed fault plan's injection ledger (docs/RESILIENCE.md)
     out["recovery"] = {**journal.stats(), "faults": _faults.stats()}
+    # live-weight canary controllers (serve/weights.py): per-route state
+    # machine, promotions/rollbacks, gate failures with reasons
+    try:
+        from tpu_air.serve.weights import controller_stats as _wctl
+
+        weights = _wctl()
+    except Exception:  # noqa: BLE001 — stats must never 500 the proxy
+        weights = {}
+    if weights:
+        out["weights"] = weights
     return out
 
 

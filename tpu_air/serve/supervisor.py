@@ -63,6 +63,7 @@ class JournalEntry:
     max_new_tokens: Optional[int]
     priority: str
     deadline_ms: Optional[float]  # absolute unix-epoch ms
+    adapter_id: Optional[str] = None  # tenant LoRA adapter, None = base
     tokens: List[int] = field(default_factory=list)  # delivered prefix
     done: bool = False
     # after a replay: (new replica tag, new request id, token offset) — the
@@ -89,14 +90,16 @@ class RequestJournal:
     def record_submit(self, prefix: str, pin: str, request_id: int, *,
                       prompt, max_new_tokens: Optional[int],
                       priority: str,
-                      deadline_ms: Optional[float]) -> None:
+                      deadline_ms: Optional[float],
+                      adapter_id: Optional[str] = None) -> None:
         entry = JournalEntry(
             prefix=prefix, pin=pin, request_id=int(request_id),
             prompt=[int(t) for t in (prompt or [])],
             max_new_tokens=(None if max_new_tokens is None
                             else int(max_new_tokens)),
             priority=str(priority),
-            deadline_ms=(None if deadline_ms is None else float(deadline_ms)))
+            deadline_ms=(None if deadline_ms is None else float(deadline_ms)),
+            adapter_id=(None if adapter_id is None else str(adapter_id)))
         with self._lock:
             self._entries[(prefix, pin, int(request_id))] = entry
             while len(self._entries) > self._cap:
@@ -157,6 +160,10 @@ class RequestJournal:
             }
             if entry.deadline_ms is not None:
                 payload["deadline_ms"] = entry.deadline_ms
+            if entry.adapter_id is not None:
+                # the continuation must decode under the SAME tenant
+                # adapter or the forced-prefix replay changes tokens
+                payload["adapter_id"] = entry.adapter_id
             body = json.dumps(payload).encode()
             deadline = Deadline.at_ms(entry.deadline_ms)
             backoff = Backoff(base=0.05, cap=1.0, seed=0)

@@ -1,0 +1,761 @@
+"""Live weights: a versioned store over the shm object store, plus the
+health-gated canary controller that moves a serving fleet onto them.
+
+Three layers, bottom-up:
+
+* :class:`WeightStore` — versioned full/adapter checkpoints.  Tensor
+  payloads live in an :class:`~tpu_air.core.object_store.ObjectStore`
+  (atomic seal per object); a JSON manifest per version records each
+  tensor's object id, shape, dtype and crc32.  The manifest is written
+  LAST via tmp+rename, so a version EXISTS only once every shard it
+  names is sealed — a publisher killed mid-publish leaves orphan shards
+  and no manifest, never a half-version (the ``weights.publish`` chaos
+  test pins this).  Reads re-checksum every tensor: a corrupt shard
+  raises :class:`WeightsIntegrityError` instead of serving garbage.
+  Version ids are monotone per store; retain-N GC deletes old full
+  versions' objects and manifests.
+
+* probe helpers — a publish can pin a greedy probe: a fixed prompt set,
+  its expected tokens and a sha256 fingerprint (optionally last-position
+  logits + a tolerance for quantized bases, where exact token match is
+  too strict).  :func:`offline_greedy` is the reference decode loop the
+  fingerprint is computed with — deliberately independent of the engine
+  (plain per-token ``model.apply``), the same anchor the engine parity
+  tests pin against.
+
+* :class:`WeightsController` — the canary state machine over a
+  :class:`~tpu_air.serve.deployment.DeploymentHandle`.  ``promote()``
+  swaps ONE replica, runs the probe gate, holds a soak window in which
+  SLO burn (observability/slo.py) must stay quiet, and only then swaps
+  the rest of the fleet; any gate failure rolls the canary back to the
+  prior version (an engine-held device tree — rollback never reads the
+  store, so it survives a corrupt or GC'd publish) and surfaces the
+  failure in ``/-/stats`` (``weights`` section) and
+  ``tpu_air_weights_*`` metrics.  Adapter versions promote through the
+  same gate as cheap sub-swaps (bank row writes, not full-tree swaps).
+
+Concurrency: the store is single-writer by contract (the trainer);
+readers only ever see sealed objects + renamed manifests.  Controller
+state is guarded by one lock; all replica RPCs happen OUTSIDE it (a
+slow replica must not wedge ``stats()``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpu_air.core.object_store import ObjectStore
+from tpu_air.faults import plan as _faults
+
+__all__ = [
+    "GateFailedError",
+    "TornPublishError",
+    "WeightStore",
+    "WeightsController",
+    "WeightsIntegrityError",
+    "attach_weights",
+    "compute_probe",
+    "controller_stats",
+    "install_controller",
+    "offline_greedy",
+    "probe_fingerprint",
+]
+
+
+class TornPublishError(Exception):
+    """A publish died before its manifest landed.  The version does not
+    exist: readers never see it, a retry re-publishes under the same
+    number (sealed shards are overwritten via rename)."""
+
+
+class WeightsIntegrityError(Exception):
+    """A restore-path read failed validation: missing shard, shape/dtype
+    drift, or a crc32 mismatch against the manifest."""
+
+
+class GateFailedError(Exception):
+    """The canary health gate rejected a version (probe mismatch, SLO
+    burn during soak, or the swap RPC itself failing)."""
+
+
+# ---------------------------------------------------------------------------
+# param tree <-> flat tensor list
+# ---------------------------------------------------------------------------
+
+def _flatten(tree: Dict[str, Any], prefix: str = "") -> List[Tuple[str, Any]]:
+    """Nested-dict params to sorted ``(path, leaf)`` pairs ("/"-joined
+    paths — fine in manifests; object ids never contain them)."""
+    out: List[Tuple[str, Any]] = []
+    for k in sorted(tree):
+        v = tree[k]
+        path = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.extend(_flatten(v, path))
+        else:
+            out.append((path, v))
+    return out
+
+
+def _unflatten(pairs: List[Tuple[str, Any]]) -> Dict[str, Any]:
+    root: Dict[str, Any] = {}
+    for path, leaf in pairs:
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return root
+
+
+# ---------------------------------------------------------------------------
+# the versioned store
+# ---------------------------------------------------------------------------
+
+_MANIFEST_RE = re.compile(r"^manifest-(\d{6})\.json$")
+
+
+class WeightStore:
+    """Versioned weight checkpoints over the shm object store.
+
+    ``root`` holds the manifests; tensor objects live in a private
+    :class:`ObjectStore` at ``root/objects`` unless ``store`` hands in a
+    shared one (object ids are ``w{version:06d}-{idx:04d}`` — no path
+    separators, unique per store root).  Single writer (the trainer);
+    any number of readers.
+    """
+
+    def __init__(self, root: str, store: Optional[ObjectStore] = None):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._store = store or ObjectStore(
+            os.path.join(root, "objects"), create=True)
+
+    # -- version bookkeeping -------------------------------------------------
+    def _manifest_path(self, version: int) -> str:
+        return os.path.join(self.root, f"manifest-{version:06d}.json")
+
+    def versions(self) -> List[int]:
+        """Published (manifest-sealed) versions, ascending.  Unparsable
+        manifest files are skipped, not fatal — one bad file must not
+        take down every reader."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in names:
+            m = _MANIFEST_RE.match(name)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    json.load(f)
+            except (OSError, ValueError):
+                continue
+            out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_version(self) -> Optional[int]:
+        vs = self.versions()
+        return vs[-1] if vs else None
+
+    def manifest(self, version: int) -> Dict[str, Any]:
+        try:
+            with open(self._manifest_path(version)) as f:
+                return json.load(f)
+        except OSError as e:
+            raise KeyError(f"no published version {version}") from e
+
+    # -- publish -------------------------------------------------------------
+    def _next_version(self) -> int:
+        # scan-max + 1: monotone over PUBLISHED versions.  A torn publish
+        # never sealed a manifest, so a retry reuses its number; the
+        # publisher deletes each orphan shard id before re-putting it
+        # (objects are immutable — a bare put over an existing id keeps
+        # the OLD bytes and the manifest checksum would then lie).
+        return (self.latest_version() or 0) + 1
+
+    def publish(self, params: Dict[str, Any], *,
+                metadata: Optional[Dict[str, Any]] = None,
+                probe: Optional[Dict[str, Any]] = None) -> int:
+        """Publish a full weight tree; returns the new version id.
+
+        Order is the whole integrity story: every tensor object is put
+        (and atomically sealed) FIRST, the manifest naming them is
+        renamed into place LAST.  Fault hooks (site ``weights.publish``,
+        keyed by tensor path, then ``manifest``): ``kill`` aborts before
+        the manifest (torn publish — raises :class:`TornPublishError`),
+        ``corrupt`` flips a tensor's VALUES before checksumming (loads
+        cleanly, decodes wrong — the canary gate's quarry), ``delay``
+        stalls in place."""
+        flat = _flatten(params)
+        version = self._next_version()
+        tensors = []
+        for i, (path, leaf) in enumerate(flat):
+            arr = np.asarray(leaf)
+            if _faults.enabled():
+                spec = _faults.perturb("weights.publish", key=path)
+                if spec is not None and spec.action == "kill":
+                    raise TornPublishError(
+                        f"airfault: publisher killed before shard {i} "
+                        f"({path}) of version {version}; no manifest "
+                        f"written")
+                if spec is not None and spec.action == "corrupt":
+                    # bad VALUES with a valid checksum: sign-flip + shift
+                    # survives every dtype and changes greedy argmaxes
+                    arr = (arr * -1 + 1).astype(arr.dtype)
+            oid = f"w{version:06d}-{i:04d}"
+            tensors.append({
+                "path": path,
+                "object_id": oid,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()),
+            })
+            # evict a torn predecessor's orphan shard first: objects are
+            # immutable, so putting over a live id would keep its bytes
+            self._store.delete(oid)
+            self._store.put(arr, oid)
+        manifest = {
+            "version": version,
+            "kind": "full",
+            "tensors": tensors,
+            "metadata": dict(metadata or {}),
+            "probe": probe,
+            "created_at": time.time(),
+        }
+        if _faults.enabled():
+            _faults.perturb("weights.publish", key="manifest")
+        self._write_manifest(version, manifest)
+        return version
+
+    def publish_adapter(self, name: str, a, b, *,
+                        metadata: Optional[Dict[str, Any]] = None,
+                        probe: Optional[Dict[str, Any]] = None) -> int:
+        """Publish one tenant's LoRA head delta (``a``: [d, r], ``b``:
+        [r, V]) as an adapter version — same manifest/checksum/atomicity
+        discipline as :meth:`publish`, tiny payload."""
+        return self._publish_kind(
+            {"a": np.asarray(a, np.float32), "b": np.asarray(b, np.float32)},
+            kind="adapter",
+            metadata={**(metadata or {}), "adapter": str(name)},
+            probe=probe)
+
+    def _publish_kind(self, tree, *, kind, metadata, probe) -> int:
+        flat = _flatten(tree)
+        version = self._next_version()
+        tensors = []
+        for i, (path, leaf) in enumerate(flat):
+            arr = np.asarray(leaf)
+            if _faults.enabled():
+                spec = _faults.perturb("weights.publish", key=path)
+                if spec is not None and spec.action == "kill":
+                    raise TornPublishError(
+                        f"airfault: publisher killed mid-publish of "
+                        f"{kind} version {version}")
+                if spec is not None and spec.action == "corrupt":
+                    arr = (arr * -1 + 1).astype(arr.dtype)
+            oid = f"w{version:06d}-{i:04d}"
+            tensors.append({
+                "path": path,
+                "object_id": oid,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()),
+            })
+            self._store.delete(oid)  # same orphan-shard eviction as publish()
+            self._store.put(arr, oid)
+        manifest = {
+            "version": version,
+            "kind": kind,
+            "tensors": tensors,
+            "metadata": dict(metadata or {}),
+            "probe": probe,
+            "created_at": time.time(),
+        }
+        if _faults.enabled():
+            _faults.perturb("weights.publish", key="manifest")
+        self._write_manifest(version, manifest)
+        return version
+
+    def _write_manifest(self, version: int, manifest: Dict[str, Any]) -> None:
+        path = self._manifest_path(version)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+
+    # -- restore -------------------------------------------------------------
+    def load(self, version: Optional[int] = None) -> Dict[str, Any]:
+        """Restore a version's tensors as a nested param dict, validating
+        EVERY read against the manifest (shape, dtype, crc32) — the
+        restore path never trusts ``get()`` to have returned the bytes
+        the publisher wrote."""
+        if version is None:
+            version = self.latest_version()
+            if version is None:
+                raise KeyError(f"weight store {self.root} has no "
+                               f"published versions")
+        man = self.manifest(version)
+        pairs = []
+        for t in man["tensors"]:
+            try:
+                arr = np.asarray(self._store.get(t["object_id"], timeout=10.0))
+            except TimeoutError as e:
+                raise WeightsIntegrityError(
+                    f"version {version}: shard {t['object_id']} "
+                    f"({t['path']}) missing from the object store") from e
+            if (list(arr.shape) != list(t["shape"])
+                    or str(arr.dtype) != t["dtype"]):
+                raise WeightsIntegrityError(
+                    f"version {version}: shard {t['path']} is "
+                    f"{arr.dtype}{list(arr.shape)}, manifest says "
+                    f"{t['dtype']}{t['shape']}")
+            crc = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+            if crc != int(t["crc32"]):
+                raise WeightsIntegrityError(
+                    f"version {version}: shard {t['path']} crc32 "
+                    f"{crc:#x} != manifest {int(t['crc32']):#x}")
+            pairs.append((t["path"], arr))
+        return _unflatten(pairs)
+
+    def load_adapter(self, version: int) -> Tuple[str, np.ndarray, np.ndarray]:
+        """Restore an adapter version: ``(tenant_name, a, b)``."""
+        man = self.manifest(version)
+        if man.get("kind") != "adapter":
+            raise ValueError(f"version {version} is kind "
+                             f"{man.get('kind')!r}, not an adapter")
+        tree = self.load(version)
+        return str(man["metadata"]["adapter"]), tree["a"], tree["b"]
+
+    # -- retention -----------------------------------------------------------
+    def gc(self, keep: int = 2) -> List[int]:
+        """Delete all but the newest ``keep`` FULL versions (objects and
+        manifests; adapter versions are evicted explicitly via the
+        controller, not by retention).  Returns the versions removed."""
+        full = [v for v in self.versions()
+                if self.manifest(v).get("kind") == "full"]
+        doomed = full[:-keep] if keep > 0 else full
+        for v in doomed:
+            try:
+                man = self.manifest(v)
+            except KeyError:
+                continue
+            for t in man.get("tensors", ()):
+                try:
+                    self._store.delete(t["object_id"])
+                except OSError:
+                    pass
+            try:
+                os.remove(self._manifest_path(v))
+            except OSError:
+                pass
+        return doomed
+
+
+# ---------------------------------------------------------------------------
+# greedy probes
+# ---------------------------------------------------------------------------
+
+def probe_fingerprint(token_lists: Sequence[Sequence[int]]) -> str:
+    """Canonical sha256 over a probe's greedy outputs."""
+    canon = json.dumps([[int(t) for t in seq] for seq in token_lists],
+                       separators=(",", ":"))
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+def offline_greedy(model, params, prompt: Sequence[int], max_new: int,
+                   adapter_a=None, adapter_b=None) -> List[int]:
+    """Reference greedy decode, one token per ``model.apply`` — the
+    independent loop probe fingerprints are pinned with (and the adapter
+    parity tests compare against).  Emits EOS inclusive then stops,
+    matching the engine's stream contract.  ``adapter_a``/``adapter_b``
+    apply a LoRA head delta ``logits += (h @ a) @ b``."""
+    import jax.numpy as jnp
+
+    from tpu_air.models.lm.config import LMConfig
+    from tpu_air.models.lm.generate import init_cache
+    from tpu_air.models.lm.modeling import CausalLM, head_weight
+
+    prompt = [int(t) for t in prompt]
+    cfg = model.config
+    total = len(prompt) + max_new
+    dmodel = CausalLM(LMConfig.from_dict(
+        {**cfg.to_dict(), "max_seq_len": total}))
+    cache = init_cache(dmodel, 1)
+    lp = len(prompt)
+    ids = jnp.asarray([prompt], jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(lp, dtype=jnp.int32), (1, lp))
+    hidden, vars_ = dmodel.apply(
+        {"params": params, "cache": cache}, ids, positions,
+        decode=True, return_hidden=True, mutable=["cache"])
+    head_w = head_weight(params, cfg).astype(jnp.float32)
+    a = None if adapter_a is None else jnp.asarray(adapter_a, jnp.float32)
+    b = None if adapter_b is None else jnp.asarray(adapter_b, jnp.float32)
+
+    def pick(h):
+        logits = h @ head_w
+        if a is not None:
+            logits = logits + (h @ a) @ b
+        return int(jnp.argmax(logits))
+
+    tok = pick(hidden[0, -1].astype(jnp.float32))
+    out = [tok]
+    eos = cfg.eos_token_id
+    cache, pos = vars_["cache"], lp
+    while len(out) < max_new and (eos is None or tok != eos):
+        hidden, vars_ = dmodel.apply(
+            {"params": params, "cache": cache},
+            jnp.asarray([[tok]], jnp.int32),
+            jnp.full((1, 1), pos, jnp.int32),
+            decode=True, return_hidden=True, mutable=["cache"])
+        cache, pos = vars_["cache"], pos + 1
+        tok = pick(hidden[0, -1].astype(jnp.float32))
+        out.append(tok)
+    return out
+
+
+def probe_logits(model, params, prompts: Sequence[Sequence[int]]
+                 ) -> List[List[float]]:
+    """Last-prompt-position logits per probe prompt (fp32 lists) — the
+    tolerance-compare surface for quantized bases, where exact greedy
+    token match across a requantize is too strict."""
+    import jax.numpy as jnp
+
+    from tpu_air.models.lm.config import LMConfig
+    from tpu_air.models.lm.generate import init_cache
+    from tpu_air.models.lm.modeling import CausalLM, head_weight
+
+    cfg = model.config
+    out = []
+    for prompt in prompts:
+        prompt = [int(t) for t in prompt]
+        lp = len(prompt)
+        dmodel = CausalLM(LMConfig.from_dict(
+            {**cfg.to_dict(), "max_seq_len": lp}))
+        cache = init_cache(dmodel, 1)
+        ids = jnp.asarray([prompt], jnp.int32)
+        positions = jnp.broadcast_to(
+            jnp.arange(lp, dtype=jnp.int32), (1, lp))
+        hidden, _ = dmodel.apply(
+            {"params": params, "cache": cache}, ids, positions,
+            decode=True, return_hidden=True, mutable=["cache"])
+        head_w = head_weight(params, cfg).astype(jnp.float32)
+        logits = hidden[0, -1].astype(jnp.float32) @ head_w
+        out.append([float(x) for x in np.asarray(logits)])
+    return out
+
+
+def compute_probe(model, params, prompts: Sequence[Sequence[int]],
+                  max_new: int = 8, *, adapter_a=None, adapter_b=None,
+                  with_logits: bool = False,
+                  logit_tolerance: Optional[float] = None
+                  ) -> Dict[str, Any]:
+    """Pin a probe for a publish: run the fixed prompt set greedily under
+    the candidate weights and fingerprint the outputs.  The canary gate
+    replays these prompts through the SERVING engine and requires the
+    fingerprint to match exactly — or, with ``with_logits`` +
+    ``logit_tolerance`` (quantized bases), the last-position logits to
+    stay within tolerance."""
+    toks = [offline_greedy(model, params, p, max_new,
+                           adapter_a=adapter_a, adapter_b=adapter_b)
+            for p in prompts]
+    probe: Dict[str, Any] = {
+        "prompts": [[int(t) for t in p] for p in prompts],
+        "max_new": int(max_new),
+        "tokens": [[int(t) for t in seq] for seq in toks],
+        "fingerprint": probe_fingerprint(toks),
+    }
+    if with_logits:
+        probe["logits"] = probe_logits(model, params, probe["prompts"])
+        probe["logit_tolerance"] = (None if logit_tolerance is None
+                                    else float(logit_tolerance))
+    return probe
+
+
+# ---------------------------------------------------------------------------
+# the canary controller
+# ---------------------------------------------------------------------------
+
+class WeightsController:
+    """Health-gated promotion of store versions onto a serving fleet.
+
+    ``promote(version)`` drives the canary state machine::
+
+        idle -> canary(swap replica 0) -> probe gate -> soak(SLO quiet)
+             -> promote(rest of fleet) -> serving
+                      \\-- any failure --> rollback(canary) -> idle
+
+    Gate knobs: ``soak_s`` (how long SLO burn must stay quiet on the
+    canary before fleet-wide promotion), ``soak_poll_s`` (burn poll
+    cadence), ``probe_timeout_s`` (per-probe engine budget).  The probe
+    itself rides in the version's manifest (``WeightStore.publish(...,
+    probe=compute_probe(...))``); versions published without one pass a
+    liveness-only gate (the probe prompts must merely decode) when
+    ``probe_prompts`` is set, else skip straight to soak.
+    """
+
+    def __init__(self, handle, store_root: str, *,
+                 probe_prompts: Optional[Sequence[Sequence[int]]] = None,
+                 probe_max_new: int = 8,
+                 soak_s: float = 0.5,
+                 soak_poll_s: float = 0.05,
+                 probe_timeout_s: float = 60.0):
+        self._handle = handle
+        self.store = WeightStore(store_root)
+        self._probe_prompts = ([[int(t) for t in p] for p in probe_prompts]
+                               if probe_prompts else None)
+        self._probe_max_new = int(probe_max_new)
+        self.soak_s = float(soak_s)
+        self.soak_poll_s = float(soak_poll_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._lock = threading.Lock()
+        self._state = "idle"
+        self._current_version: Optional[int] = None
+        self._promotions = 0
+        self._rollbacks = 0
+        self._gate_failures: Dict[str, int] = {}
+        self._last_error: Optional[str] = None
+        self._last_stall_ms = 0.0
+
+    # -- replica RPC plumbing ------------------------------------------------
+    def _replicas(self) -> list:
+        with self._handle._lock:
+            return list(self._handle._replicas)
+
+    @staticmethod
+    def _call(replica, method: str, *args, **kwargs):
+        from tpu_air.core import api as core_api
+
+        return core_api.get(
+            replica.handle.remote(method, tuple(args), dict(kwargs)))
+
+    def _set_state(self, state: str) -> None:
+        with self._lock:
+            self._state = state
+
+    def _record_gate_failure(self, reason: str, err: str) -> None:
+        with self._lock:
+            self._gate_failures[reason] = (
+                self._gate_failures.get(reason, 0) + 1)
+            self._last_error = err
+            self._state = "idle"
+
+    # -- the gate ------------------------------------------------------------
+    def _probe_gate(self, replica, man: Dict[str, Any],
+                    adapter_id: Optional[str] = None) -> None:
+        probe = (man or {}).get("probe")
+        prompts = ((probe or {}).get("prompts") or self._probe_prompts)
+        if not prompts:
+            return  # nothing pinned and no liveness prompts configured
+        max_new = int((probe or {}).get("max_new", self._probe_max_new))
+        toks = self._call(replica, "weights_probe", prompts, max_new,
+                          adapter_id=adapter_id,
+                          timeout_s=self.probe_timeout_s)
+        if probe is None:
+            return  # liveness-only: the prompts decoded without error
+        tol = probe.get("logit_tolerance")
+        if probe.get("logits") is not None and tol is not None:
+            got = self._call(replica, "weights_probe_logits", prompts)
+            worst = 0.0
+            for g, want in zip(got, probe["logits"]):
+                worst = max(worst, max(
+                    abs(float(x) - float(y)) for x, y in zip(g, want)))
+            if worst > float(tol):
+                raise GateFailedError(
+                    f"probe logits drifted {worst:.3e} > tolerance {tol}")
+            return
+        got_fp = probe_fingerprint(toks)
+        if got_fp != probe["fingerprint"]:
+            raise GateFailedError(
+                f"probe fingerprint mismatch: canary {got_fp[:12]} != "
+                f"pinned {probe['fingerprint'][:12]}")
+
+    def _soak_gate(self) -> None:
+        """SLO burn must stay quiet for the whole soak window.  No
+        monitor installed -> time-only soak (the window still gives the
+        burn monitor a chance to be installed/fed by the harness)."""
+        from tpu_air.observability import slo as _slo
+
+        deadline = time.monotonic() + self.soak_s
+        while time.monotonic() < deadline:
+            mon = _slo.monitor()
+            if mon is not None:
+                burning = mon.burning()
+                if burning:
+                    raise GateFailedError(
+                        f"SLO burning during soak: {burning}")
+            time.sleep(self.soak_poll_s)
+
+    # -- promotion -----------------------------------------------------------
+    def promote(self, version: Optional[int] = None) -> Dict[str, Any]:
+        """Canary-promote a store version across the fleet.  Returns a
+        result dict (``promoted`` bool, ``version``, ``reason`` on
+        failure); raises only on misuse (no replicas, no versions)."""
+        if version is None:
+            version = self.store.latest_version()
+            if version is None:
+                raise KeyError(
+                    f"weight store {self.store.root} has no versions")
+        man = self.store.manifest(version)
+        replicas = self._replicas()
+        if not replicas:
+            raise RuntimeError("no live replicas to promote onto")
+        if man.get("kind") == "adapter":
+            return self._promote_adapter(version, man, replicas)
+        return self._promote_full(version, man, replicas)
+
+    def _promote_full(self, version: int, man: Dict[str, Any],
+                      replicas: list) -> Dict[str, Any]:
+        canary, rest = replicas[0], replicas[1:]
+        self._set_state("canary")
+        try:
+            stall = self._call(canary, "weights_swap", self.store.root,
+                               version)
+            self._set_state("soaking")
+            self._probe_gate(canary, man)
+            self._soak_gate()
+        except Exception as e:  # noqa: BLE001 — every gate failure rolls back
+            reason = ("probe" if isinstance(e, GateFailedError)
+                      else "swap_failed")
+            try:
+                self._call(canary, "weights_rollback")
+            except Exception:  # noqa: BLE001 — replica may be gone; its
+                pass           # restart recipe rebuilds from original params
+            with self._lock:
+                self._rollbacks += 1
+            self._record_gate_failure(reason, f"v{version}: {e}")
+            return {"promoted": False, "version": version,
+                    "reason": str(e)}
+        self._set_state("promoting")
+        stalls = [stall]
+        for replica in rest:
+            try:
+                stalls.append(self._call(replica, "weights_swap",
+                                         self.store.root, version))
+            except Exception as e:  # noqa: BLE001 — a dead replica's restart
+                # recipe rebuilds it; surface, don't fail the promotion
+                with self._lock:
+                    self._last_error = (f"fleet swap on "
+                                        f"{replica._actor_id}: {e}")
+        with self._lock:
+            self._state = "serving"
+            self._current_version = version
+            self._promotions += 1
+            self._last_stall_ms = max(float(s) for s in stalls)
+        return {"promoted": True, "version": version,
+                "max_stall_ms": max(float(s) for s in stalls)}
+
+    def _promote_adapter(self, version: int, man: Dict[str, Any],
+                         replicas: list) -> Dict[str, Any]:
+        """Adapter sub-swap under the same gate: load on the canary,
+        probe UNDER the adapter, soak, then load fleet-wide.  Rollback
+        is an unload — the shared base was never touched."""
+        name, a, b = self.store.load_adapter(version)
+        canary, rest = replicas[0], replicas[1:]
+        self._set_state("canary")
+        try:
+            self._call(canary, "weights_load_adapter", name,
+                       np.asarray(a), np.asarray(b))
+            self._set_state("soaking")
+            self._probe_gate(canary, man, adapter_id=name)
+            self._soak_gate()
+        except Exception as e:  # noqa: BLE001 — same rollback contract
+            try:
+                self._call(canary, "weights_unload_adapter", name)
+            except Exception:  # noqa: BLE001 — best-effort unload
+                pass
+            with self._lock:
+                self._rollbacks += 1
+            self._record_gate_failure("adapter", f"adapter v{version}: {e}")
+            return {"promoted": False, "version": version,
+                    "adapter": name, "reason": str(e)}
+        self._set_state("promoting")
+        for replica in rest:
+            try:
+                self._call(replica, "weights_load_adapter", name,
+                           np.asarray(a), np.asarray(b))
+            except Exception as e:  # noqa: BLE001 — surface, don't fail
+                with self._lock:
+                    self._last_error = (f"adapter load on "
+                                        f"{replica._actor_id}: {e}")
+        with self._lock:
+            self._state = "serving"
+            self._promotions += 1
+        return {"promoted": True, "version": version, "adapter": name}
+
+    def evict_adapter(self, name: str) -> int:
+        """Unload a tenant adapter fleet-wide; returns replicas evicted."""
+        n = 0
+        for replica in self._replicas():
+            try:
+                if self._call(replica, "weights_unload_adapter", name):
+                    n += 1
+            except Exception:  # noqa: BLE001 — replica may be mid-restart
+                continue
+        return n
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._state,
+                "current_version": self._current_version,
+                "latest_published": self.store.latest_version(),
+                "promotions": self._promotions,
+                "rollbacks": self._rollbacks,
+                "gate_failures": dict(self._gate_failures),
+                "last_error": self._last_error,
+                "last_stall_ms": self._last_stall_ms,
+            }
+
+
+# ---------------------------------------------------------------------------
+# registry (the /-/stats "weights" section)
+# ---------------------------------------------------------------------------
+
+_controllers: Dict[str, WeightsController] = {}
+_controllers_lock = threading.Lock()
+
+
+def install_controller(route_prefix: str,
+                       ctl: WeightsController) -> WeightsController:
+    with _controllers_lock:
+        _controllers[route_prefix] = ctl
+    return ctl
+
+
+def uninstall_controller(route_prefix: str) -> None:
+    with _controllers_lock:
+        _controllers.pop(route_prefix, None)
+
+
+def controller_stats() -> Dict[str, Any]:
+    with _controllers_lock:
+        ctls = dict(_controllers)
+    return {prefix: ctl.stats() for prefix, ctl in ctls.items()}
+
+
+def attach_weights(route_prefix: str, store_root: str,
+                   **gate_kw: Any) -> WeightsController:
+    """Bind a :class:`WeightsController` to a deployed route: looks the
+    route's handle up in the running proxy and registers the controller
+    so its state shows under ``/-/stats`` -> ``weights``."""
+    from tpu_air.serve import proxy as _proxy
+
+    with _proxy._state.lock:
+        handle = _proxy._state.routes.get(route_prefix)
+    if handle is None:
+        raise KeyError(f"no deployment at route {route_prefix!r}")
+    return install_controller(
+        route_prefix, WeightsController(handle, store_root, **gate_kw))

@@ -80,6 +80,13 @@ class CheckpointConfig:
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "min"
+    # Live-serving handoff: when set, every retained checkpoint's param tree
+    # is ALSO published to the versioned WeightStore at this root (manifest
+    # last, per-tensor checksums — tpu_air/serve/weights.py), where a
+    # WeightsController canary-gates it onto serving replicas.  The store is
+    # GC'd to ``num_to_keep`` full versions (default 2 when unset) so the
+    # serving fleet always has the previous version to roll back to.
+    publish_weights_to: Optional[str] = None
 
     def __post_init__(self):
         if self.checkpoint_score_order not in ("min", "max"):

@@ -85,6 +85,8 @@ class Session:
                 pass
         if checkpoint is not None:
             self._retain(checkpoint, rec)
+            if self.checkpoint_config.publish_weights_to:
+                self._publish_weights(checkpoint, rec)
         # pass the internal monotone counter separately: user metrics may
         # override training_iteration, but report streaming must stay
         # contiguous (the Tune driver drains report-1, report-2, …)
@@ -109,6 +111,36 @@ class Session:
             attrs={"iteration": self._iter, "run_dir": self.run_dir},
         )
         self._last_report_ns = now
+
+    # -- weight publishing (live-serving handoff) ----------------------------
+    def _publish_weights(self, checkpoint: Checkpoint,
+                         metrics: Dict[str, Any]) -> None:
+        """Publish the retained checkpoint's params to the configured
+        WeightStore (CheckpointConfig.publish_weights_to).  The publish is
+        torn-proof (manifest written last) and checksummed; a failure —
+        including an injected ``weights.publish`` fault — must not kill the
+        training loop: serving simply keeps the previous version."""
+        from tpu_air.serve.weights import WeightStore
+
+        try:
+            params = checkpoint.get_params()
+        except Exception:  # noqa: BLE001 — dict/dir checkpoint without params
+            params = None
+        if params is None:
+            return
+        cfg = self.checkpoint_config
+        try:
+            store = WeightStore(cfg.publish_weights_to)
+            store.publish(params, metadata={
+                "iteration": self._iter,
+                "run_dir": self.run_dir,
+                "metrics": {k: v for k, v in metrics.items()
+                            if isinstance(v, (int, float, str))},
+            })
+            store.gc(keep=cfg.num_to_keep or 2)
+        except Exception:  # noqa: BLE001 — torn publish / store error: the
+            pass           # trial continues; the store still ends in a sealed
+            # state (no manifest for the torn version) so serving never sees it
 
     # -- retention (CheckpointConfig semantics, cc-40) ----------------------
     def _retain(self, checkpoint: Checkpoint, metrics: Dict[str, Any]):
