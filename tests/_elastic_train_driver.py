@@ -1,0 +1,103 @@
+"""Driver for the elastic-preemption train test (run as a subprocess with
+a clean jax — the XLA device-count flag binds at backend init).
+
+Becomes host 0 of a 2-host x 4-chip virtual cluster and proves the
+PR-15 elastic re-lease design end to end: a seeded FaultPlan's
+``runtime.lease`` ``notice`` spec revokes the 8-chip SPMD lease shortly
+after grant.  The trainer's marker-file stop point unwinds every host's
+session with ``LeaseRevokedError`` at the SAME iteration, the newest
+checkpoint stays retained, the data-parallel width halves (8 -> 4 chips
+= one host, so the remaining attempts land on the single-actor path),
+and the run RESUMES from the retained checkpoint — finishing with
+``error=None`` without spending any of ``max_failures`` (the preemption
+retry budget is separate from the crash budget).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_air.parallel.distributed import spawn_local_cluster  # noqa: E402
+
+NPROC, CPH = 2, 4
+
+
+def elastic_preemption_run():
+    from tpu_air import faults
+    from tpu_air.faults import FaultPlan, FaultSpec
+    from tpu_air.train import (
+        Checkpoint,
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    # the FIRST driver lease gets a revocation notice 0.8s after grant —
+    # mid-trial, between reports
+    faults.install(FaultPlan(seed=15, specs=[
+        FaultSpec("runtime.lease", "notice", at=1, delay_s=0.8,
+                  notice_s=10.0),
+    ]))
+
+    def loop(config):
+        import time as _t
+
+        import jax
+
+        from tpu_air.train import session
+
+        start = 0
+        if config.get("resume_from_checkpoint"):
+            ck = Checkpoint.from_directory(config["resume_from_checkpoint"])
+            start = ck.get_metrics()["i"]
+        for i in range(start, 6):
+            ck = Checkpoint.from_model(metrics={"i": i + 1})
+            session.report({"i": i + 1, "nproc": jax.process_count(),
+                            "loss": 10.0 - i}, checkpoint=ck)
+            _t.sleep(0.3)  # paced so the notice lands between reports
+
+    r = JaxTrainer(
+        loop,
+        # 8 chips > chips_per_host -> the SPMD-multihost path
+        scaling_config=ScalingConfig(num_workers=8, num_chips_per_worker=1),
+        # max_failures=0: the run may ONLY survive through the preemption
+        # budget — any crash-path retry would fail the fit
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=0)),
+    ).fit()
+    faults.clear()
+    assert r.error is None, r.error
+    assert r.metrics["i"] == 6, r.metrics
+    # the final attempt ran on the SHRUNK single-host lease via the actor
+    # path (one jax process), not the 2-host agent plane
+    assert r.metrics["nproc"] == 1, r.metrics
+    # and it RESUMED: the post-preemption history continues the trajectory
+    # instead of restarting at i=1
+    first = r.metrics_history[0]["i"]
+    assert first >= 2, [m["i"] for m in r.metrics_history]
+    assert [m["i"] for m in r.metrics_history] == list(range(first, 7))
+    assert r.checkpoint is not None
+    print("ELASTIC-PREEMPT-OK", flush=True)
+
+
+def main() -> int:
+    cluster = spawn_local_cluster(NPROC, CPH)
+    try:
+        import tpu_air
+
+        tpu_air.init()
+        rt = tpu_air.core.runtime.get_runtime()
+        assert rt.num_chips == 8 and rt.chips_per_host == 4, (
+            rt.num_chips, rt.chips_per_host,
+        )
+        elastic_preemption_run()
+        tpu_air.shutdown()
+    finally:
+        cluster.shutdown()
+    print("ELASTIC-TRAIN-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,17 @@ gap any replica's swap introduced (fleet-merged
 ``tpu_air_weights_swap_stall_ms_max``) — and ``swap_errors_total``,
 which must stay 0 (a swap drops no streams).
 
+A fourth **preemption** phase measures lease-revocation recovery
+(docs/RESILIENCE.md "Preemption & migration"): two single-chip replicas
+serve underload-rate traffic while a seeded ``runtime.lease`` notice
+revokes one replica's chip mid-phase; the PreemptionWatcher drains it
+and live-migrates its KV pages to the survivor.  Headlines:
+``preemption_recovery_ms`` — worst notice-to-out-of-rotation
+orchestration wall time — and ``migrated_vs_replayed`` — the fraction
+of rescued streams that moved with their KV state (zero re-prefill)
+rather than falling back to journal replay; with a generous notice it
+must be 1.0.
+
 Reported per phase and class: arrivals, completed, shed (proxy 503s and
 engine-side overload look identical to the client), proxy-side
 queued/shed counter deltas, TTFT p50/p99 both CLIENT-observed (includes
@@ -437,6 +448,69 @@ def main():
         result["swap_errors_total"] = sum(
             c["errors"] for c in result["swap"]["classes"].values())
 
+        # -- preemption phase: lease-notice revocation under live load ----
+        from tpu_air import faults
+        from tpu_air.faults import FaultPlan, FaultSpec
+        from tpu_air.serve.proxy import serve_control_stats
+
+        # fresh runtime: earlier phases rotated the chip pool, and the
+        # fault spec targets the replica whose lease key is "chips=1" —
+        # a clean pool makes the two replicas land on chips 0 and 1
+        serve.shutdown()
+        tpu_air.shutdown()
+        tpu_air.init(num_cpus=4, num_chips=8)
+        # delay_s counts from the replica's lease ATTACH (deploy time), so
+        # mid-duration leaves margin for warmup jitter before the notice
+        plan = FaultPlan(seed=args.seed, specs=[
+            FaultSpec("runtime.lease", "notice", at=1, match="chips=1",
+                      delay_s=args.duration / 2.0, notice_s=60.0)])
+        # max_restarts=0: this phase measures the DRAIN + MIGRATE cost, not
+        # replacement-spawn cost — and a respawn would re-lease the revoked
+        # chip (lowest free id) in a fresh process whose per-process fault
+        # counter re-fires the seeded notice, turning the phase into a
+        # preemption loop.  Longer streams (max_new 80, slot_len 96): on
+        # CPU a 12-token stream lives ~40 ms, so the notice instant would
+        # usually catch nothing in flight; ~80-token streams keep the
+        # slots occupied so the drain has live KV state to move.  Half
+        # background rate: the survivor must stay shallow-queued after
+        # capacity halves — queued (not-yet-decoding) streams can only be
+        # rescued by replay, and a deep post-kill queue admission-sheds
+        # best_effort replays, polluting the migrate-vs-replay signal.
+        preempt_max_new = max(args.max_new, 80)
+        preempt_cfg = EngineConfig(
+            num_slots=engine_cfg.num_slots, slot_len=96,
+            max_new_tokens=preempt_max_new, max_queue=engine_cfg.max_queue,
+            reserved_interactive_slots=engine_cfg.reserved_interactive_slots,
+        )
+        serve.run(
+            EngineDeployment.options(
+                name="bench-engine", route_prefix="/engine",
+                num_replicas=2, num_chips=1, max_restarts=0,
+            ).bind(ckpt, preempt_cfg),
+            port=PORT,
+            admission_policy=policy,
+            fault_plan=plan,
+        )
+        _post("/engine", {"prompt": prompts[0], "priority": "batch",
+                          "max_new_tokens": preempt_max_new}, timeout=300.0)
+        result["preemption"] = _run_phase(args.interactive_rps,
+                                          args.underload_rps / 2.0,
+                                          args.duration,
+                                          prompts, preempt_max_new, rng)
+        rec = serve_control_stats().get("recovery") or {}
+        result["preemption"]["recovery"] = {
+            k: rec.get(k) for k in (
+                "preemptions", "migrations", "migrated_pages",
+                "migration_fallbacks", "replays", "replay_failures",
+                "preemption_recovery_ms")}
+        result["preemption_recovery_ms"] = round(
+            float(rec.get("preemption_recovery_ms") or 0.0), 3)
+        rescued = int(rec.get("migrations") or 0) + int(rec.get("replays") or 0)
+        result["migrated_vs_replayed"] = round(
+            int(rec.get("migrations") or 0) / rescued, 3) if rescued else 0.0
+        result["preemption_errors_total"] = sum(
+            c["errors"] for c in result["preemption"]["classes"].values())
+
         under = result["underload"]["classes"]["interactive"]
         over = result["overload"]["classes"]["interactive"]
         # the headline: engine-recorded interactive p99 TTFT under
@@ -461,6 +535,9 @@ def main():
     finally:
         serve.shutdown()
         tpu_air.shutdown()
+        from tpu_air import faults as _faults
+
+        _faults.clear()
 
     blob = json.dumps(result, indent=1)
     print(blob)

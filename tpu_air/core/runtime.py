@@ -74,6 +74,100 @@ class ActorDiedError(TpuAirError):
     pass
 
 
+class ChipLease(list):
+    """A granted chip lease: a ``list`` of physical chip ids (drop-in for
+    the plain ``List[int]`` existing callers index, join, and pass back to
+    :meth:`Runtime.release_chips`) plus revocation plumbing for preemptible
+    capacity.
+
+    Real TPU preemption arrives with *notice*: the infrastructure says
+    "these chips go away in N seconds", and a holder that drains or
+    migrates within the window loses nothing.  The handle models exactly
+    that: :meth:`on_revoke` registers a callback; when the lease is
+    revoked (by the ``runtime.lease`` fault site's ``notice`` action or by
+    :meth:`Runtime.revoke_lease`), every callback fires once with the
+    advance warning in seconds, and ``notice_s`` seconds later the lease
+    reports :attr:`expired` — past that point the holder must treat the
+    chips as gone.
+
+    Callbacks run on the revoker's thread and never under the handle's
+    lock; a callback registered *after* the notice was delivered fires
+    immediately (no lost-wakeup window between engine construction and
+    watcher registration).
+    """
+
+    def __init__(self, chip_ids):
+        super().__init__(chip_ids)
+        self._lease_lock = threading.Lock()
+        self._callbacks: List[Any] = []
+        self._notice_s: Optional[float] = None
+        self._expired = threading.Event()
+
+    @property
+    def chip_ids(self) -> List[int]:
+        return list(self)
+
+    @property
+    def revoking(self) -> bool:
+        """True once a revocation notice has been delivered."""
+        with self._lease_lock:
+            return self._notice_s is not None
+
+    @property
+    def notice_s(self) -> Optional[float]:
+        """The advance warning the notice carried, or None if not revoked."""
+        with self._lease_lock:
+            return self._notice_s
+
+    @property
+    def expired(self) -> bool:
+        """True once the notice window has elapsed: the chips are gone."""
+        return self._expired.is_set()
+
+    def on_revoke(self, callback) -> None:
+        """Register ``callback(notice_s: float)`` to fire when this lease
+        is revoked.  Fires immediately (on the caller's thread) if the
+        notice already arrived."""
+        with self._lease_lock:
+            if self._notice_s is None:
+                self._callbacks.append(callback)
+                return
+            notice = self._notice_s
+        callback(notice)
+
+    def deliver_notice(self, notice_s: float) -> None:
+        """Deliver the revocation notice: fire callbacks with ``notice_s``
+        of warning, then mark the lease expired once the window elapses.
+        Idempotent — only the first delivery counts."""
+        notice = max(0.0, float(notice_s))
+        with self._lease_lock:
+            if self._notice_s is not None:
+                return
+            self._notice_s = notice
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            try:
+                cb(notice)
+            except Exception:  # a broken callback must not mask the notice
+                pass
+        if notice > 0:
+            t = threading.Timer(notice, self._expired.set)
+            t.daemon = True
+            t.start()
+        else:
+            self._expired.set()
+
+    def wait_expired(self, timeout: Optional[float] = None) -> bool:
+        return self._expired.wait(timeout)
+
+    def __reduce__(self):
+        # a lease crossing a process boundary (spmd closures pickled to
+        # host agents) degrades to its chip ids — the revocation plumbing
+        # (lock, timer, callbacks) is meaningful only in the driver that
+        # holds the lease
+        return (list, (list(self),))
+
+
 class _ErrorSentinel:
     """Stored in the object store in place of a result when a task fails."""
 
@@ -862,13 +956,15 @@ class Runtime:
             self.free_chips = saved
         return reserved
 
-    def lease_chips(self, n: int, timeout: Optional[float] = None) -> List[int]:
+    def lease_chips(self, n: int, timeout: Optional[float] = None) -> ChipLease:
         """Driver-level chip lease (shape-aware, docs/MULTIHOST.md §2) for
         runs that execute on the driver itself rather than in an actor —
         the SPMD-multihost trainer path.  Blocks until a correctly-shaped
         lease frees up, honoring the hosts reserved for queued actor
         requests (``_queued_reservations``) so driver leases cannot starve
-        a shape-blocked queue head.  Pair with :meth:`release_chips`."""
+        a shape-blocked queue head.  Returns a :class:`ChipLease` (a list
+        of chip ids carrying ``on_revoke`` preemption plumbing).  Pair
+        with :meth:`release_chips`."""
         self._check_satisfiable({"chip": float(n)})
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
@@ -880,18 +976,37 @@ class Runtime:
                     if ids is not None:
                         self._acquire({"chip": float(n)})
             if ids is not None:
+                lease = ChipLease(ids)
                 if _faults.enabled():
                     try:
-                        _faults.perturb("runtime.lease", key=str(n))
+                        spec = _faults.perturb("runtime.lease", key=str(n))
                     except _faults.LeaseRevokedError:
                         # the claim must not leak: hand the chips back
                         # before surfacing the revocation
                         self.release_chips(ids)
                         raise
-                return ids
+                    if spec is not None and spec.action == "notice":
+                        # graceful preemption: grant the lease, then
+                        # delay_s later deliver notice_s of warning via
+                        # the handle (preemption lands mid-work, not at
+                        # acquisition)
+                        t = threading.Timer(
+                            spec.delay_s, lease.deliver_notice,
+                            args=(spec.notice_s,))
+                        t.daemon = True
+                        t.start()
+                return lease
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"no {n}-chip lease available after {timeout}s")
             time.sleep(0.05)
+
+    def revoke_lease(self, lease: ChipLease, notice_s: float = 0.0) -> None:
+        """Programmatic preemption: deliver a revocation notice to a lease
+        this runtime granted.  The holder's ``on_revoke`` callbacks fire
+        with ``notice_s`` of warning; the holder still calls
+        :meth:`release_chips` when its drain completes (or the driver
+        reclaims on expiry)."""
+        lease.deliver_notice(notice_s)
 
     def release_chips(self, chip_ids: List[int]) -> None:
         with self.lock:
@@ -1529,3 +1644,39 @@ def get_runtime() -> Runtime:
     if _runtime is None:
         init()
     return _runtime
+
+
+def attach_chip_lease(chip_ids: Optional[List[int]] = None) -> ChipLease:
+    """ACTOR-side lease attachment: wrap the chips this process was placed
+    on (``TPU_AIR_CHIP_IDS``, set by the worker loop at task start, or an
+    explicit ``chip_ids``) in a :class:`ChipLease` so in-actor holders —
+    the serving engine, a training step — get the same ``on_revoke``
+    preemption surface as driver-side :meth:`Runtime.lease_chips` holders.
+
+    Consults the ``runtime.lease`` fault site exactly like the driver
+    path, with one difference: a cold ``revoke`` here delivers an
+    immediate zero-notice revocation through the handle instead of
+    raising — the actor is already *placed* on the chips, so the
+    interesting failure is losing them mid-work, not failing to get
+    them."""
+    if chip_ids is None:
+        raw = os.environ.get("TPU_AIR_CHIP_IDS", "")
+        chip_ids = [int(c) for c in raw.split(",") if c.strip()]
+    lease = ChipLease(chip_ids)
+    if _faults.enabled():
+        try:
+            # keyed by the PHYSICAL chip ids so a plan's ``match`` can aim
+            # a preemption at the replica holding a specific chip
+            spec = _faults.perturb(
+                "runtime.lease",
+                key="chips=" + ",".join(str(c) for c in lease),
+            )
+        except _faults.LeaseRevokedError:
+            spec = None
+            lease.deliver_notice(0.0)
+        if spec is not None and spec.action == "notice":
+            t = threading.Timer(spec.delay_s, lease.deliver_notice,
+                                args=(spec.notice_s,))
+            t.daemon = True
+            t.start()
+    return lease

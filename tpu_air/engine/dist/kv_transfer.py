@@ -19,6 +19,14 @@ import numpy as np
 from tpu_air.faults import plan as _faults
 
 
+class KVTransferError(ValueError):
+    """A shipped KV payload does not fit the destination cache — wrong
+    page count, page shape, or a dtype the destination cannot hold
+    losslessly.  Raised *before* any page is written: a migrated stream
+    that cannot be inserted cleanly falls back to journal replay instead
+    of decoding from silently-corrupted pages."""
+
+
 def _kv_layers(cache, path=()):
     """Yield ``('/'.join(path), layer_dict)`` for every attention-layer
     cache dict (the ones holding cached_key/cached_value pools)."""
@@ -46,13 +54,62 @@ def extract_kv_pages(cache, page_ids) -> Dict[str, Dict[str, np.ndarray]]:
     return out
 
 
+def _lossless_cast(src: np.dtype, dst: np.dtype) -> bool:
+    """Can every value of ``src`` be represented in ``dst``?  ``safe``
+    casting is exactly that rule; exotic dtypes numpy cannot reason about
+    (possible with custom cache dtypes) count as lossy."""
+    try:
+        return bool(np.can_cast(src, dst, casting="safe"))
+    except TypeError:
+        return False
+
+
+def validate_kv_payload(cache, page_ids, payload) -> None:
+    """Check a shipped payload against the destination cache, raising
+    :class:`KVTransferError` on any mismatch — truncated page counts,
+    wrong page geometry, missing layers, or lossy dtype narrowing.  Runs
+    before any write so a bad payload corrupts nothing."""
+    n = len(page_ids)
+    for path, layer in _kv_layers(cache):
+        pages = payload.get(path)
+        if pages is None:
+            raise KVTransferError(
+                f"kv payload missing layer {path!r} "
+                f"(shipped layers: {sorted(payload)})")
+        for name, key in (("k", "cached_key"), ("v", "cached_value")):
+            if name not in pages:
+                raise KVTransferError(
+                    f"kv payload at {path!r} missing {name!r} pages")
+            arr = np.asarray(pages[name])
+            dst = layer[key]
+            if arr.ndim != dst.ndim or arr.shape[0] != n:
+                raise KVTransferError(
+                    f"truncated kv payload at {path}/{name}: shipped "
+                    f"shape {arr.shape} for {n} destination page ids")
+            if tuple(arr.shape[1:]) != tuple(dst.shape[1:]):
+                raise KVTransferError(
+                    f"kv page shape mismatch at {path}/{name}: payload "
+                    f"pages are {tuple(arr.shape[1:])}, destination pool "
+                    f"holds {tuple(dst.shape[1:])}")
+            src_dt, dst_dt = arr.dtype, np.dtype(dst.dtype)
+            if src_dt != dst_dt and not _lossless_cast(src_dt, dst_dt):
+                raise KVTransferError(
+                    f"kv dtype mismatch at {path}/{name}: payload "
+                    f"{src_dt} does not fit destination {dst_dt} "
+                    "losslessly")
+
+
 def insert_kv_pages(cache, page_ids, payload: Dict[str, Dict[str, np.ndarray]]):
     """Write shipped pages into ``page_ids`` of this cache (functional —
     returns the rebuilt cache; the caller rebinds its donated cache).
     ``page_ids[i]`` receives the payload's i-th page: id lists on both
     sides are in prompt order, so source and destination ids need not
-    match — each engine allocates in its own pool."""
+    match — each engine allocates in its own pool.  Raises
+    :class:`KVTransferError` (before writing anything) when the payload
+    does not fit the destination cache."""
     import jax.numpy as jnp
+
+    validate_kv_payload(cache, page_ids, payload)
 
     ids = jnp.asarray(np.asarray(page_ids, np.int32))
 

@@ -165,6 +165,7 @@ class InferenceEngine:
         self._step_lock = threading.Lock()
         self._closed = False
         self._draining = False
+        self._preempting = False
         self._round_admits = 0  # slots taken during one admission round
         self._thread: Optional[threading.Thread] = None
         if auto_start:
@@ -345,14 +346,18 @@ class InferenceEngine:
             worked = False
             self._begin_admission_round()
             self._round_admits = 0
-            for req in self.scheduler.pop_admissible(
-                self.slots.free_count(), self._admit_gate()
-            ):
-                if self.paged:
-                    self._admit_paged(req)
-                else:
-                    self._admit(req)
-                worked = True
+            # airlint: disable=CC001 — _preempting is a GIL-atomic
+            # monotonic bool (False→True once); an admission round racing
+            # the flip just admits one last batch before the freeze
+            if not self._preempting:
+                for req in self.scheduler.pop_admissible(
+                    self.slots.free_count(), self._admit_gate()
+                ):
+                    if self.paged:
+                        self._admit_paged(req)
+                    else:
+                        self._admit(req)
+                    worked = True
             if self.paged and self._prefill_quantum():
                 worked = True
             if any(not s.prefilling for s in self.slots.active_slots()):
@@ -393,6 +398,125 @@ class InferenceEngine:
     def drained(self) -> bool:
         """True once draining AND no admitted work remains."""
         return self._draining and self.idle()
+
+    # -- preemption (lease revoked with notice) -------------------------------
+    def preempt(self) -> None:
+        """A lease-revocation notice arrived: stop admitting ANYTHING.
+        New submits shed (:class:`EngineDrainingError` — the proxy routes
+        elsewhere) and the already-queued backlog STAYS queued — unlike a
+        rollout drain, prefilling it here would burn the notice window on
+        work this replica cannot finish; the journal replays it on a
+        survivor once the replica goes away.  Idempotent."""
+        self._draining = True
+        # airlint: disable=CC001 — GIL-atomic monotonic flip, never unset
+        self._preempting = True
+
+    @property
+    def preempting(self) -> bool:
+        # airlint: disable=CC001 — GIL-atomic monotonic bool read
+        return self._preempting
+
+    def migrate_out(self) -> List[Dict[str, Any]]:
+        """Preemption drain: freeze the loop between steps and pull every
+        DECODING slot's live state into portable payloads for
+        :meth:`submit_migrated` on a survivor.
+
+        Each payload carries everything the destination needs to continue
+        the stream exactly: the original prompt, every client-visible
+        token emitted so far, the decode cursor, the remaining budget, the
+        SLO class/deadline/tenant, and the KV pages covering positions
+        ``0..pos-1`` (:func:`extract_kv_pages`).  Mid-prefill slots and
+        the queued backlog are NOT shipped — their cheapest recovery is
+        the journal-replay fallback, since little or none of their compute
+        exists yet.  Migrated slots are released here (the destination
+        owns the stream's future); their source streams are abandoned
+        unfinished, and the proxy re-pins pollers at the destination.
+        """
+        if not self.paged:
+            raise ValueError(
+                "migrate_out requires a paged engine (kv_mode='paged')")
+        self.preempt()
+        from .dist.kv_transfer import extract_kv_pages  # lazy: avoids cycle
+
+        payloads: List[Dict[str, Any]] = []
+        with self._step_lock:
+            for slot in list(self.slots.active_slots()):
+                if slot.prefilling:
+                    continue
+                req = slot.request
+                p = int(slot.pos)
+                page_ids = self.pool.prompt_page_ids(slot.index, p)
+                # airlint: disable=CC003 — the only sleep reachable here is
+                # a test-only fault-injection delay; the loop is frozen by
+                # design while live state is pulled
+                pages = extract_kv_pages(self.cache, page_ids)
+                payloads.append({
+                    "request_id": req.request_id,
+                    "prompt": [int(t) for t in req.prompt],
+                    "streamed": req.stream.tokens_so_far(),
+                    "pos": p,
+                    "budget_left": int(slot.budget_left),
+                    "priority": req.priority,
+                    "deadline_ms": req.deadline_ms,
+                    "adapter_id": req.adapter_id,
+                    "pages": pages,
+                })
+                self.metrics.record_migration("out", len(page_ids))
+                # every token this stream emitted stays useful — the
+                # destination continues it, so nothing here is waste and
+                # the slot is released without finishing the stream
+                self.pool.release(slot.index)
+                self.slots.release(slot)
+                self._cur_tok[slot.index] = 0
+                self._pos[slot.index] = 0
+                self._adapter_ids_host[slot.index] = 0
+        return payloads
+
+    def submit_migrated(self, payload: Dict[str, Any], *,
+                        stream: Optional[ResponseStream] = None
+                        ) -> ResponseStream:
+        """Land one :meth:`migrate_out` payload on this engine.
+
+        Validates the shipped pages against this cache's geometry BEFORE
+        queueing (:class:`~tpu_air.engine.dist.kv_transfer.KVTransferError`
+        surfaces synchronously so the supervisor can fall back to replay),
+        then admission allocates unshared pages, inserts the K/V, replays
+        the already-delivered tokens onto the fresh stream, and decode
+        continues from the exact cursor — zero prefill chunks run, and
+        greedy continuations are token-identical to the stream never
+        having moved."""
+        if not self.paged:
+            raise ValueError(
+                "submit_migrated requires a paged engine (kv_mode='paged')")
+        from .dist.kv_transfer import validate_kv_payload  # lazy: no cycle
+
+        prompt = [int(t) for t in payload["prompt"]]
+        streamed = [int(t) for t in payload["streamed"]]
+        pos = int(payload["pos"])
+        budget_left = int(payload["budget_left"])
+        if not streamed or budget_left < 1 \
+                or pos != len(prompt) + len(streamed) - 1:
+            raise RequestValidationError(
+                f"inconsistent migration payload: prompt={len(prompt)} "
+                f"streamed={len(streamed)} pos={pos} "
+                f"budget_left={budget_left}")
+        n_pages = -(-pos // self.config.page_len)
+        # airlint: disable=CC001 — geometry-only read; the cache is rebound
+        # under _step_lock but every rebinding preserves layout, so a stale
+        # reference validates identically
+        validate_kv_payload(self.cache, range(n_pages), payload["pages"])
+        # the cache-resident context is positions 0..pos-1: the prompt plus
+        # every emitted token but the last (the cursor token is computed,
+        # not yet written) — that context is the "prompt" the pool admits
+        context = (prompt + streamed)[:pos]
+        req = self._make_request(context, budget_left + 1, stream,
+                                 payload.get("priority", "interactive"),
+                                 admit_while_draining=True,
+                                 deadline_ms=payload.get("deadline_ms"),
+                                 adapter_id=payload.get("adapter_id"))
+        req.migrated = {"streamed": streamed, "pages": payload["pages"],
+                        "client_prompt_len": len(prompt)}
+        return self._enqueue(req)
 
     def _admit_gate(self):
         """Per-round admission predicate handed to the scheduler.  Combines
@@ -455,6 +579,9 @@ class InferenceEngine:
         if req.prefilled is not None:
             self._admit_prefilled(slot, req)
             return
+        if req.migrated is not None:
+            self._admit_migrated(slot, req)
+            return
         slot.prefilling = True
         slot.plan = self.pool.admit(slot.index, req.prompt, req.max_new_tokens)
         # chunks about to be recomputed whose content the prefix cache held
@@ -475,8 +602,12 @@ class InferenceEngine:
             slot.index, req.prompt, req.max_new_tokens, share=False)
         slot.plan.chunks_done = len(slot.plan.chunk_starts)  # nothing to run
         page_ids = self.pool.prompt_page_ids(slot.index, n)
-        self.cache = self._insert_shipped_pages(
-            self.cache, page_ids, req.prefilled["pages"])
+        try:
+            self.cache = self._insert_shipped_pages(
+                self.cache, page_ids, req.prefilled["pages"])
+        except ValueError as e:  # KVTransferError: payload does not fit
+            self._fail_admission(slot, req, e)
+            return
         first = int(req.prefilled["first_token"])
         req.first_token_at = time.monotonic()
         if req.t_submit_ns:
@@ -496,6 +627,67 @@ class InferenceEngine:
         self._pos[slot.index] = n
         if slot.budget_left == 0 or (
             self.eos_token_id is not None and first == self.eos_token_id
+        ):
+            self._retire(slot)
+
+    def _fail_admission(self, slot: Slot, req: Request,
+                        error: BaseException) -> None:
+        """Admission found the request unservable (bad shipped payload):
+        give the slot back and fail the stream LOUDLY — the poller sees
+        the typed error and the journal falls back to replay, instead of
+        this engine decoding from corrupt pages."""
+        self.pool.release(slot.index)
+        self.slots.release(slot)
+        self._cur_tok[slot.index] = 0
+        self._pos[slot.index] = 0
+        self._adapter_ids_host[slot.index] = 0
+        req.stream._finish(error)
+
+    def _admit_migrated(self, slot: Slot, req: Request) -> None:
+        """Migration landing (:meth:`submit_migrated`): like
+        :meth:`_admit_prefilled` but for a stream that was already
+        DECODING elsewhere.  Allocates unshared pages sized for the whole
+        remaining run, inserts the shipped K/V, replays the client-visible
+        tokens onto the stream, and parks the cursor exactly where the
+        source stopped — ``chunks_done`` covers the whole chunk list, so
+        ZERO prefill chunks run (``migrations.in_reprefill_chunks`` stays
+        0; the acceptance test pins it).  The pages are NOT registered
+        with the prefix cache: the tail page is mid-append and the
+        admitted "prompt" includes generated tokens — publishing it would
+        let a future prompt share a page decode is still writing into."""
+        m = req.migrated
+        p = len(req.prompt)          # cache-resident positions 0..p-1
+        slot.plan = self.pool.admit(
+            slot.index, req.prompt, req.max_new_tokens, share=False)
+        slot.plan.chunks_done = len(slot.plan.chunk_starts)  # nothing to run
+        page_ids = self.pool.prompt_page_ids(slot.index, p)
+        try:
+            self.cache = self._insert_shipped_pages(
+                self.cache, page_ids, m["pages"])
+        except ValueError as e:  # KVTransferError: payload does not fit
+            self._fail_admission(slot, req, e)
+            return
+        req.first_token_at = time.monotonic()
+        if req.t_submit_ns:
+            # t_first == t_admit: the > guard in _emit_request_spans keeps
+            # the (source-replica) prefill from re-reporting here
+            req.t_first_ns = req.t_admit_ns
+        streamed = m["streamed"]
+        for tok in streamed:
+            # already counted and TTFT-stamped on the source — replayed
+            # onto the fresh stream so it carries the FULL client-visible
+            # list (the proxy re-pins pollers with offset 0)
+            req.stream._emit(tok)
+        slot.prefilling = False
+        slot.pos = p
+        slot.budget_left = req.max_new_tokens - 1
+        self._cur_tok[slot.index] = streamed[-1]
+        self._pos[slot.index] = p
+        self.metrics.record_migration(
+            "in", len(page_ids), reprefill_chunks=slot.plan.chunks_left)
+        if slot.budget_left == 0 or (
+            self.eos_token_id is not None
+            and streamed[-1] == self.eos_token_id
         ):
             self._retire(slot)
 

@@ -64,6 +64,9 @@ class EngineMetrics:
         # directly
         self.submitted_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self.rejected_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        # per-tenant-quota sheds by class (serve/admission.py tenant
+        # budgets: the 429s, distinct from the overload 503s in ``shed``)
+        self.quota_shed_by_class: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self._ttft_by_class: Dict[str, Histogram] = {
             p: Histogram() for p in PRIORITIES
         }
@@ -88,6 +91,9 @@ class EngineMetrics:
         # live-weight state (empty until the first swap/adapter load —
         # snapshot shape unchanged for engines that never hot-swap)
         self.weights: Dict[str, Any] = {}
+        # preemption migration counters (empty until the first migrate —
+        # same absent-until-used contract as ``weights``)
+        self.migrations: Dict[str, Any] = {}
         register(self)
 
     def set_topology(self, **kw: Any) -> None:
@@ -136,6 +142,31 @@ class EngineMetrics:
     def record_complete(self) -> None:
         with self._lock:
             self.requests_completed += 1
+
+    def record_quota_shed(self, priority: str = "interactive") -> None:
+        """A request shed by a per-tenant quota (HTTP 429) — the tenant
+        exceeded ITS budget while the engine had capacity, so it counts
+        apart from the overload ``shed``."""
+        with self._lock:
+            if priority in self.quota_shed_by_class:
+                self.quota_shed_by_class[priority] += 1
+
+    def record_migration(self, direction: str, pages: int,
+                         reprefill_chunks: int = 0) -> None:
+        """One live-slot migration through this engine: ``direction`` is
+        ``"out"`` (slot extracted off a preempting replica) or ``"in"``
+        (payload landed here).  ``reprefill_chunks`` counts prefill chunk
+        programs the landing still has to run — zero by construction, and
+        the preemption chaos test pins it at zero."""
+        key = "out" if direction == "out" else "in"
+        with self._lock:
+            mg = self.migrations
+            mg[key] = int(mg.get(key, 0)) + 1
+            mg[key + "_pages"] = int(mg.get(key + "_pages", 0)) + int(pages)
+            if key == "in":
+                mg["in_reprefill_chunks"] = (
+                    int(mg.get("in_reprefill_chunks", 0))
+                    + int(reprefill_chunks))
 
     def record_ttft(self, seconds: float, priority: str = "interactive",
                     trace_id: Optional[str] = None) -> None:
@@ -244,6 +275,7 @@ class EngineMetrics:
                     p: {
                         "submitted": self.submitted_by_class[p],
                         "shed": self.rejected_by_class[p],
+                        "quota_shed": self.quota_shed_by_class[p],
                         "queue_depth": self.queue_by_class.get(p, 0),
                         "ttft_s": self._ttft_by_class[p].summary(),
                     }
@@ -259,6 +291,8 @@ class EngineMetrics:
                 out["topology"] = dict(self.topology)
             if self.weights:
                 out["weights"] = dict(self.weights)
+            if self.migrations:
+                out["migrations"] = dict(self.migrations)
         out["tokens_per_s"] = self.tokens_per_s()
         return out
 
@@ -310,6 +344,8 @@ def merge_snapshots(snapshots: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         prio[p] = {
             "submitted": sum(int(e.get("submitted", 0)) for e in entries),
             "shed": sum(int(e.get("shed", 0)) for e in entries),
+            "quota_shed": sum(int(e.get("quota_shed", 0))
+                              for e in entries),
             "queue_depth": sum(int(e.get("queue_depth", 0))
                                for e in entries),
             "ttft_s": merge_summaries([e.get("ttft_s") or {}
@@ -319,6 +355,11 @@ def merge_snapshots(snapshots: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     perfs = [s.get("perf") for s in snaps if s.get("perf")]
     if perfs:
         out["perf"] = merge_ledger_snapshots(perfs)
+    migs = [s.get("migrations") for s in snaps if s.get("migrations")]
+    if migs:
+        keys = sorted(set().union(*migs))
+        out["migrations"] = {
+            k: sum(int(m.get(k, 0)) for m in migs) for k in keys}
     ws = [s.get("weights") for s in snaps if s.get("weights")]
     if ws:
         # fleet view: swaps/rollbacks sum, the serving version is the max
@@ -368,6 +409,8 @@ _FAMILIES = [
      "requests accepted per priority class"),
     ("tpu_air_engine_priority_shed", "counter",
      "requests shed per priority class"),
+    ("tpu_air_engine_priority_quota_shed", "counter",
+     "requests shed by per-tenant quotas per priority class (HTTP 429)"),
     ("tpu_air_engine_priority_queue_depth", "gauge",
      "queued requests per priority class"),
     ("tpu_air_engine_priority_ttft_s", "histogram",
@@ -406,6 +449,15 @@ _FAMILIES = [
      "worst decode-step gap across all swaps, milliseconds"),
     ("tpu_air_weights_adapters_loaded", "gauge",
      "tenant LoRA adapters resident in the bank"),
+    # preemption migration plane: absent until an engine migrates
+    ("tpu_air_engine_migrations", "counter",
+     "live slots migrated, by direction (out = extracted off a "
+     "preempting replica, in = landed here)"),
+    ("tpu_air_engine_migrated_pages", "counter",
+     "KV pages shipped by live-slot migration, by direction"),
+    ("tpu_air_engine_migration_reprefill_chunks", "counter",
+     "prefill chunk programs a migration landing had to re-run "
+     "(zero-re-prefill contract: stays 0)"),
 ]
 
 
@@ -473,7 +525,7 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
         # per-priority-class counters/gauges ({engine=...,priority=...})
         for prio, pc in sorted((snap.get("priority") or {}).items()):
             ptag = f'{{engine="{label}",priority="{prio}"}}'
-            for key in ("submitted", "shed", "queue_depth"):
+            for key in ("submitted", "shed", "quota_shed", "queue_depth"):
                 if key in pc:
                     b.raw(f"tpu_air_engine_priority_{key}",
                           f"tpu_air_engine_priority_{key}{ptag} {pc[key]}")
@@ -539,6 +591,20 @@ def prometheus_lines(snapshots: Dict[str, Dict[str, Any]] = None) -> list:
             b.raw("tpu_air_weights_swap_stall_ms_max",
                   f"tpu_air_weights_swap_stall_ms_max{tag} "
                   f"{float(w['max_stall_ms']):.3f}")
+        # preemption migration counters (absent until an engine migrates)
+        mg = snap.get("migrations") or {}
+        for direction in ("out", "in"):
+            if direction in mg:
+                dtag = f'{{engine="{label}",direction="{direction}"}}'
+                b.raw("tpu_air_engine_migrations",
+                      f"tpu_air_engine_migrations{dtag} {int(mg[direction])}")
+                b.raw("tpu_air_engine_migrated_pages",
+                      f"tpu_air_engine_migrated_pages{dtag} "
+                      f"{int(mg.get(direction + '_pages', 0))}")
+        if "in_reprefill_chunks" in mg:
+            b.raw("tpu_air_engine_migration_reprefill_chunks",
+                  f"tpu_air_engine_migration_reprefill_chunks{tag} "
+                  f"{int(mg['in_reprefill_chunks'])}")
         # topology: strings fold into one info line's labels, numbers
         # (replica counts, device counts) become gauges
         topo = snap.get("topology") or {}

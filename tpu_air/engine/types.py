@@ -251,6 +251,14 @@ class Request:
     # admission inserts the pages and goes straight to decode.  None for
     # the normal (engine-prefills) path.
     prefilled: Optional[dict] = None
+    # preemption migration (engine ``migrate_out`` → ``submit_migrated``):
+    # a stream that was already DECODING on a preempted replica arrives
+    # with every client-visible token it emitted there plus the KV pages
+    # covering its context ({"streamed": [int], "pages": {...},
+    # "client_prompt_len": int}); admission inserts the pages, force-emits
+    # the streamed tokens, and resumes decode at the exact cursor — zero
+    # prefill chunks.  None for every other path.
+    migrated: Optional[dict] = None
     # end-to-end deadline as ABSOLUTE unix-epoch milliseconds (a relative
     # budget would silently re-extend at every hop).  The proxy converts
     # the client's relative budget at admission; the scheduler expires

@@ -27,7 +27,11 @@ site                  actions
 ``object_store.get``  ``delay`` (slow fetch), ``drop`` (TimeoutError)
 ``actor.call``        ``delay``, ``kill`` (crash the target actor)
 ``runtime.task``      ``delay``
-``runtime.lease``     ``revoke`` (LeaseRevokedError after claim)
+``runtime.lease``     ``revoke`` (LeaseRevokedError after claim),
+                      ``notice`` (graceful preemption: the lease is
+                      granted, then ``delay_s`` later the holder's
+                      ``on_revoke`` callback fires with ``notice_s``
+                      of warning before the chips are reclaimed)
 ``prefill.worker``    ``slow`` (gray failure), ``kill`` (os._exit)
 ``kv.transfer``       ``delay``
 ``proxy.request``     ``delay``
@@ -91,7 +95,15 @@ class FaultSpec:
                    counted per process).
     ``count``    — keep firing for this many consecutive hits (gray
                    failures are sustained slowness, not a single blip).
-    ``delay_s``  — sleep duration for delay/slow actions.
+    ``delay_s``  — sleep duration for delay/slow actions; for ``notice``
+                   it is how long after the lease grant the revocation
+                   notice is delivered (preemption lands mid-decode, not
+                   at acquisition time).
+    ``notice_s`` — advance warning carried by a ``notice`` action: how
+                   long the holder has between the ``on_revoke`` callback
+                   and the chips actually being reclaimed.  ``0`` means
+                   "no time to migrate" — the drain must fall back to
+                   journal replay.
     ``match``    — optional substring filter on the hit key (e.g. an
                    actor id or object id); empty matches everything.
     """
@@ -101,10 +113,12 @@ class FaultSpec:
     at: int = 1
     count: int = 1
     delay_s: float = 0.0
+    notice_s: float = 0.0
     match: str = ""
 
     def __post_init__(self):
-        if self.at < 1 or self.count < 1 or self.delay_s < 0:
+        if self.at < 1 or self.count < 1 or self.delay_s < 0 \
+                or self.notice_s < 0:
             raise ValueError(f"bad fault spec: {self}")
 
 
@@ -147,6 +161,10 @@ class FaultPlan:
             "proxy.request": lambda: FaultSpec(
                 "proxy.request", "delay", at=rng.randint(1, 4),
                 delay_s=round(rng.uniform(0.01, 0.1), 3)),
+            "runtime.lease": lambda: FaultSpec(
+                "runtime.lease", "notice", at=rng.randint(1, 2),
+                delay_s=round(rng.uniform(0.2, 0.8), 3),
+                notice_s=round(rng.uniform(2.0, 5.0), 3)),
             "train.report": lambda: FaultSpec(
                 "train.report", "kill", at=rng.randint(2, 4)),
             "weights.publish": lambda: FaultSpec(
@@ -243,9 +261,11 @@ def perturb(site: str, key: str = "") -> Optional[FaultSpec]:
     ``delay``/``slow`` sleep here; ``drop`` raises ``TimeoutError`` (the
     same error a real store timeout produces); ``error`` raises
     :class:`FaultInjectedError`; ``revoke`` raises
-    :class:`LeaseRevokedError`.  ``kill`` is returned to the caller — only
-    the hook site knows what dying means there (``os._exit`` in a worker,
-    ``crash_actor`` from the driver)."""
+    :class:`LeaseRevokedError`.  ``kill`` and ``notice`` are returned to
+    the caller — only the hook site knows what dying means there
+    (``os._exit`` in a worker, ``crash_actor`` from the driver), and only
+    the lease site can schedule an advance-warning revocation against the
+    handle it is about to return."""
     spec = hit(site, key)
     if spec is None:
         return None

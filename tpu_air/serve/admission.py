@@ -60,6 +60,20 @@ class AdmissionShedError(TpuAirError):
         self.retry_after_s = retry_after_s
 
 
+class QuotaExceededError(AdmissionShedError):
+    """One TENANT (``adapter_id``) is over its per-tenant queue share —
+    not a capacity problem, a fairness one, so it maps to HTTP 429 (the
+    client is the thing to slow down, not the fleet) while still carrying
+    ``Retry-After``.  Subclasses :class:`AdmissionShedError` so callers
+    that only know the overload contract keep working; the proxy catches
+    THIS class first to pick the status code."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0,
+                 adapter_id: Optional[str] = None):
+        super().__init__(msg, retry_after_s=retry_after_s)
+        self.adapter_id = adapter_id
+
+
 @dataclass(frozen=True)
 class AdmissionPolicy:
     """Dials for one route's admission controller.
@@ -77,7 +91,19 @@ class AdmissionPolicy:
     ``queue_timeout_s`` bounds the proxy-side wait before a queued class
     sheds; ``stats_ttl_s`` is the gauge-scrape cache horizon (stale stats
     also disable least-loaded routing in the handle); ``retry_after_s``
-    rides back on shed responses as the ``Retry-After`` header."""
+    rides back on shed responses as the ``Retry-After`` header.
+
+    Per-TENANT quotas (multi-tenant LoRA serving — the tenant key is the
+    request's ``adapter_id``, ``None`` meaning the base-model tenant):
+
+    * ``tenant_token_budgets`` — per-tenant ``max_new_tokens`` caps,
+      composing with the class budget by MIN (the tighter bound wins);
+    * ``tenant_queue_shares`` — fraction of total route capacity
+      (``queue_hard × live replicas``) one tenant may hold IN FLIGHT at
+      once.  Over-share submits raise :class:`QuotaExceededError`
+      (HTTP 429 + ``Retry-After``) regardless of class — quotas compose
+      with priority, they don't replace it.  Tenants absent from the
+      mapping are unmetered."""
 
     token_budgets: Dict[str, int] = field(
         default_factory=lambda: dict(_DEFAULT_TOKEN_BUDGETS))
@@ -89,16 +115,43 @@ class AdmissionPolicy:
     queue_poll_s: float = 0.05
     retry_after_s: float = 1.0
     stats_ttl_s: float = 0.25
+    tenant_token_budgets: Optional[Dict[str, int]] = None
+    tenant_queue_shares: Optional[Dict[str, float]] = None
 
     def clamp_budget(self, priority: str,
-                     max_new_tokens: Optional[int]) -> Optional[int]:
-        """The effective decode budget for one request of this class.  An
-        UNSET request stays unset — the engine config's own default (sized
-        to its slots) governs; the class budget only trims explicit asks."""
+                     max_new_tokens: Optional[int],
+                     adapter_id: Optional[str] = None) -> Optional[int]:
+        """The effective decode budget for one request of this class (and
+        tenant).  An UNSET request stays unset — the engine config's own
+        default (sized to its slots) governs; the class budget only trims
+        explicit asks.  A tenant budget composes by MIN with the class
+        budget, and — unlike the class budget — also caps UNSET asks (a
+        metered tenant must not inherit the engine default)."""
         cap = self.token_budgets.get(priority)
-        if cap is None or max_new_tokens is None:
-            return max_new_tokens
-        return min(int(max_new_tokens), int(cap))
+        tenant_cap = None
+        if self.tenant_token_budgets is not None and adapter_id is not None:
+            tenant_cap = self.tenant_token_budgets.get(adapter_id)
+        if max_new_tokens is None:
+            return int(tenant_cap) if tenant_cap is not None else None
+        out = int(max_new_tokens)
+        if cap is not None:
+            out = min(out, int(cap))
+        if tenant_cap is not None:
+            out = min(out, int(tenant_cap))
+        return out
+
+    def tenant_inflight_cap(self, adapter_id: Optional[str],
+                            replicas: int) -> Optional[int]:
+        """Max concurrent in-flight requests for one tenant, or ``None``
+        when the tenant is unmetered.  Scales with the live replica count
+        so a share keeps meaning as the autoscaler acts."""
+        if self.tenant_queue_shares is None or adapter_id is None:
+            return None
+        share = self.tenant_queue_shares.get(adapter_id)
+        if share is None:
+            return None
+        return max(1, round(float(share) * self.queue_hard
+                            * max(int(replicas), 1)))
 
 
 class AdmissionController:
@@ -120,6 +173,13 @@ class AdmissionController:
         self.admitted = {p: 0 for p in PRIORITIES}
         self.queued = {p: 0 for p in PRIORITIES}
         self.shed = {p: 0 for p in PRIORITIES}
+        # per-class QUOTA sheds (429s) — folded into the merged engine
+        # snapshot as ``priority.<class>.quota_shed`` so the metric rides
+        # the same /metrics families as engine-side sheds
+        self.quota_shed = {p: 0 for p in PRIORITIES}
+        # tenant → currently in-flight request count (admitted minus
+        # released); only metered tenants appear
+        self._tenant_inflight: Dict[str, int] = {}
 
     # -- gauges ---------------------------------------------------------------
     def gauges(self, force: bool = False) -> Dict[str, Any]:
@@ -182,38 +242,89 @@ class AdmissionController:
             return "queue"
         return "admit"
 
-    def admit(self, priority: str) -> None:
+    def _check_quota(self, priority: str,
+                     adapter_id: Optional[str]) -> None:
+        """Raise :class:`QuotaExceededError` (and count the 429) when the
+        tenant is at its in-flight cap; otherwise take one in-flight unit.
+        Quota is checked BEFORE the class decision so a hot tenant cannot
+        burn proxy-side queue waits on requests that were never going to
+        admit."""
+        if (self.policy.tenant_queue_shares is None
+                or adapter_id is None
+                or adapter_id not in self.policy.tenant_queue_shares):
+            return  # unmetered: never touches the handle
+        cap = self.policy.tenant_inflight_cap(
+            adapter_id, self._handle.num_replicas())
+        if cap is None:
+            return
+        with self._lock:
+            held = self._tenant_inflight.get(adapter_id, 0)
+            if held >= cap:
+                self.quota_shed[priority] += 1
+                raise QuotaExceededError(
+                    f"tenant {adapter_id!r} is at its queue share "
+                    f"({held}/{cap} in flight)",
+                    retry_after_s=self.policy.retry_after_s,
+                    adapter_id=adapter_id,
+                )
+            self._tenant_inflight[adapter_id] = held + 1
+
+    def release(self, adapter_id: Optional[str]) -> None:
+        """Return one in-flight unit for a metered tenant — the proxy
+        calls this when the request completes, sheds downstream, or its
+        stream finishes delivery.  No-op for unmetered tenants."""
+        if (self.policy.tenant_queue_shares is None
+                or adapter_id is None
+                or adapter_id not in self.policy.tenant_queue_shares):
+            return
+        with self._lock:
+            held = self._tenant_inflight.get(adapter_id, 0)
+            if held > 0:
+                self._tenant_inflight[adapter_id] = held - 1
+
+    def admit(self, priority: str,
+              adapter_id: Optional[str] = None) -> None:
         """Admit-or-raise for one new request: a "queue" decision waits
         proxy-side (re-scraping each poll) up to the class's
         ``queue_timeout_s``, then sheds.  Raises
-        :class:`AdmissionShedError` on shed; returns normally on admit."""
-        decision = self.decide(priority)
-        if decision == "admit":
+        :class:`QuotaExceededError` when the tenant is over its share
+        (429), :class:`AdmissionShedError` on class shed (503); returns
+        normally on admit — the caller then owes a matching
+        :meth:`release` for metered tenants."""
+        self._check_quota(priority, adapter_id)
+        try:
+            decision = self.decide(priority)
+            if decision == "admit":
+                with self._lock:
+                    self.admitted[priority] += 1
+                return
+            p = self.policy
+            if decision == "queue":
+                with self._lock:
+                    self.queued[priority] += 1
+                deadline = time.monotonic() + float(
+                    p.queue_timeout_s.get(priority, 0.0))
+                while time.monotonic() < deadline:
+                    time.sleep(p.queue_poll_s)
+                    decision = self.decide(priority)
+                    if decision == "admit":
+                        with self._lock:
+                            self.admitted[priority] += 1
+                        return
+                    if decision == "shed":
+                        break
             with self._lock:
-                self.admitted[priority] += 1
-            return
-        p = self.policy
-        if decision == "queue":
-            with self._lock:
-                self.queued[priority] += 1
-            deadline = time.monotonic() + float(
-                p.queue_timeout_s.get(priority, 0.0))
-            while time.monotonic() < deadline:
-                time.sleep(p.queue_poll_s)
-                decision = self.decide(priority)
-                if decision == "admit":
-                    with self._lock:
-                        self.admitted[priority] += 1
-                    return
-                if decision == "shed":
-                    break
-        with self._lock:
-            self.shed[priority] += 1
-        raise AdmissionShedError(
-            f"{priority}-class shed at the proxy "
-            f"(queue depth/replica past policy thresholds)",
-            retry_after_s=p.retry_after_s,
-        )
+                self.shed[priority] += 1
+            raise AdmissionShedError(
+                f"{priority}-class shed at the proxy "
+                f"(queue depth/replica past policy thresholds)",
+                retry_after_s=p.retry_after_s,
+            )
+        except AdmissionShedError:
+            # the in-flight unit taken by _check_quota is only owed on
+            # ADMIT — hand it back on any shed path
+            self.release(adapter_id)
+            raise
 
     # -- observability --------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
@@ -224,9 +335,15 @@ class AdmissionController:
                     "queue_high": self.policy.queue_high,
                     "queue_hard": self.policy.queue_hard,
                     "token_budgets": dict(self.policy.token_budgets),
+                    "tenant_token_budgets": dict(
+                        self.policy.tenant_token_budgets or {}),
+                    "tenant_queue_shares": dict(
+                        self.policy.tenant_queue_shares or {}),
                 },
                 "admitted": dict(self.admitted),
                 "queued": dict(self.queued),
                 "shed": dict(self.shed),
+                "quota_shed": dict(self.quota_shed),
+                "tenant_inflight": dict(self._tenant_inflight),
                 "gauges": dict(self._gauges),
             }

@@ -104,6 +104,7 @@ class Autoscaler:
         self._last_action_at = -1e18  # monotonic stamp of the last scale
         self.scale_ups = 0
         self.scale_downs = 0
+        self.preemption_scale_ups = 0
         self.last_decision = "hold"
         self.last_burning: tuple = ()
 
@@ -200,6 +201,26 @@ class Autoscaler:
             return "down"
         return "hold"
 
+    def notice_scale_up(self) -> bool:
+        """Preemption signal (serve/supervisor.py PreemptionWatcher): a
+        lease-revocation notice means capacity is about to LEAVE, which is
+        a stronger fact than any gauge — add a replica immediately,
+        bypassing the cooldown (the cooldown paces reactions to noisy
+        load signals, not to announced capacity loss) and the idle
+        streak.  No-op at ``max_replicas``.  Returns whether a replica
+        was added."""
+        if self._handle.num_replicas() >= self.config.max_replicas:
+            return False
+        if self._handle.scale_up():
+            with self._lock:
+                self.scale_ups += 1
+                self.preemption_scale_ups += 1
+                self._idle_ticks = 0
+                self._last_action_at = monotonic()
+                self.last_decision = "up"
+            return True
+        return False
+
     def _loop(self) -> None:
         # Event.wait as the tick timer: stop() interrupts a sleeping loop
         # immediately instead of waiting out the period
@@ -232,6 +253,7 @@ class Autoscaler:
                 "replicas": replicas,
                 "scale_ups": self.scale_ups,
                 "scale_downs": self.scale_downs,
+                "preemption_scale_ups": self.preemption_scale_ups,
                 "idle_ticks": self._idle_ticks,
                 "last_decision": self.last_decision,
                 "burning_slos": list(self.last_burning),

@@ -31,6 +31,7 @@ actor boundary as ``RemoteError`` and the proxy maps them to HTTP 503
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional
 
 from .deployment import Deployment
@@ -72,6 +73,11 @@ class _EngineServer:
         # that delivered `done` still answers (insertion-ordered, bounded)
         self._finished: Dict[int, list] = {}
         self._draining = False
+        # preemption: the chip lease this replica sits on (attached when
+        # the engine builds) and the revocation notice, if one arrived
+        self._lease = None
+        self._preempt_notice_s: Optional[float] = None
+        self._preempt_at: Optional[float] = None
 
     def _ensure_engine(self):
         if self._engine is None:
@@ -127,7 +133,26 @@ class _EngineServer:
                     engine=self._engine, dtype=self._dtype,
                     name=self._engine_name, **self._disagg,
                 )
+            # attach the chip lease this actor was placed on: a revocation
+            # notice (runtime.lease fault site, or a real preemption in
+            # prod) freezes admission immediately, and the supervisor's
+            # watcher sees it via preempt_status and orchestrates
+            # migrate-or-replay from the driver side
+            from tpu_air.core.runtime import attach_chip_lease
+
+            self._lease = attach_chip_lease()
+            self._lease.on_revoke(self._on_preempt)
         return self._engine
+
+    def _on_preempt(self, notice_s: float) -> None:
+        """Lease-revocation callback (the revoker's thread): stamp the
+        notice and freeze engine admission.  The queued backlog stays
+        queued — the notice window belongs to LIVE slots."""
+        self._preempt_notice_s = float(notice_s)
+        self._preempt_at = time.monotonic()
+        engine = self._engine
+        if engine is not None and hasattr(engine, "preempt"):
+            engine.preempt()
 
     def _front(self):
         """The submit surface: the disagg router when configured (prefill
@@ -320,6 +345,53 @@ class _EngineServer:
             "pending_streams": pending,
             "drained": bool(self._draining and engine_done and pending == 0),
         }
+
+    # -- preemption (serve/supervisor.py PreemptionWatcher RPCs) --------------
+    def preempt_status(self) -> Dict[str, Any]:
+        """Cheap poll surface for the driver-side watcher.  Never forces
+        the lazy engine build; ``notice_left_s`` is how much of the
+        revocation window remains (the watcher's migrate-vs-replay
+        input)."""
+        if self._preempt_notice_s is None:
+            return {"preempting": False}
+        left = self._preempt_notice_s - (time.monotonic() - self._preempt_at)
+        return {
+            "preempting": True,
+            "notice_s": self._preempt_notice_s,
+            "notice_left_s": max(0.0, left),
+        }
+
+    def migrate_out(self) -> list:
+        """Freeze this replica's engine and pull every live decoding
+        slot's state into portable payloads (prompt + streamed tokens +
+        KV pages).  Also flips the engine into preemption drain if the
+        notice callback hasn't already."""
+        engine = self._ensure_engine()
+        if not hasattr(engine, "migrate_out"):
+            raise ValueError(
+                "migrate_out needs the paged causal-LM engine "
+                f"(this replica serves {type(engine).__name__})")
+        # the abandoned source streams stay in ``_streams`` on purpose: a
+        # client poll racing the migration window must keep getting 200s
+        # (a stale-but-correct prefix) until the supervisor re-pins the
+        # journal entry to the destination — this replica is going away,
+        # so its drain accounting no longer matters
+        return engine.migrate_out()
+
+    def submit_migrated(self, payload: Dict[str, Any]) -> int:
+        """Land one migrated stream on THIS replica (the survivor side of
+        a preemption migration).  Raises synchronously — KVTransferError /
+        RequestValidationError cross the actor boundary as RemoteError —
+        when the payload cannot be admitted cleanly, so the supervisor
+        falls back to journal replay."""
+        engine = self._ensure_engine()
+        if not hasattr(engine, "submit_migrated"):
+            raise ValueError(
+                "submit_migrated needs the paged causal-LM engine "
+                f"(this replica serves {type(engine).__name__})")
+        stream = engine.submit_migrated(payload)
+        self._streams[stream.request_id] = stream
+        return stream.request_id
 
     def stats(self) -> Dict[str, Any]:
         # a dashboard scrape must NEVER force the lazy engine build (model

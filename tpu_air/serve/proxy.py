@@ -51,7 +51,12 @@ from tpu_air.faults import plan as _faults
 from tpu_air.faults.retry import DeadlineExceededError
 from tpu_air.observability import tracing as _tracing
 
-from .admission import AdmissionController, AdmissionPolicy, AdmissionShedError
+from .admission import (
+    AdmissionController,
+    AdmissionPolicy,
+    AdmissionShedError,
+    QuotaExceededError,
+)
 from .autoscaler import Autoscaler, AutoscalerConfig
 from .deployment import (
     Application,
@@ -60,7 +65,7 @@ from .deployment import (
     ReplicaGoneError,
     start_replicas,
 )
-from .supervisor import RequestJournal, journaled_poll
+from .supervisor import PreemptionWatcher, RequestJournal, journaled_poll
 
 #: request header that pins streaming polls to the replica holding their
 #: stream; the proxy sets it on every routed response
@@ -97,6 +102,7 @@ class _ServeState:
         self.routes: Dict[str, DeploymentHandle] = {}
         self.admission: Dict[str, AdmissionController] = {}
         self.autoscalers: Dict[str, Autoscaler] = {}
+        self.watchers: Dict[str, PreemptionWatcher] = {}
         self.server: Optional[ThreadingHTTPServer] = None
         self.thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
@@ -104,6 +110,10 @@ class _ServeState:
         # in-flight streaming requests (prompt + delivered prefix) for
         # crash replay — serve/supervisor.py
         self.journal = RequestJournal()
+        # metered-tenant streams holding an in-flight quota unit:
+        # (prefix, pin, request_id) -> (controller, adapter_id); released
+        # when a poll observes the stream's end (or its terminal error)
+        self.tenant_streams: Dict[tuple, tuple] = {}
 
     def match(self, path: str):
         """Longest-prefix route match → ``(prefix, handle)`` (the prefix
@@ -197,6 +207,10 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         pin = None
+        # a metered tenant's in-flight quota unit taken at admit and owed
+        # a release by THIS request (blocking calls release on response;
+        # streaming submits hand the unit to the stream's lifetime)
+        quota_hold = None
         try:
             try:
                 payload = json.loads(body) if body else None
@@ -226,9 +240,16 @@ class _Handler(BaseHTTPRequestHandler):
                     if controller is not None:
                         priority = str(
                             payload.get("priority") or "interactive")
-                        controller.admit(priority)  # raises on shed
+                        adapter_id = payload.get("adapter_id")
+                        if adapter_id is not None:
+                            adapter_id = str(adapter_id)
+                        # raises QuotaExceededError (429) / shed (503)
+                        controller.admit(priority, adapter_id=adapter_id)
+                        if adapter_id is not None:
+                            quota_hold = (controller, adapter_id)
                         clamped = controller.policy.clamp_budget(
-                            priority, payload.get("max_new_tokens"))
+                            priority, payload.get("max_new_tokens"),
+                            adapter_id)
                         if clamped is not None and clamped != payload.get(
                                 "max_new_tokens"):
                             payload["max_new_tokens"] = clamped
@@ -258,9 +279,17 @@ class _Handler(BaseHTTPRequestHandler):
             if action == "poll":
                 # journal-aware poll: keeps the delivered prefix current and
                 # replays the stream on a live replica if the pin is dead
-                result, tag = journaled_poll(
-                    _state.journal, handle, prefix, payload, pin,
-                    timeout=call_timeout)
+                rid = payload.get("request_id", -1)
+                try:
+                    result, tag = journaled_poll(
+                        _state.journal, handle, prefix, payload, pin,
+                        timeout=call_timeout)
+                except Exception:  # terminal for the client either way
+                    # hand back the stream's tenant quota unit (idempotent)
+                    _drop_stream_hold(prefix, pin, rid)
+                    raise
+                if isinstance(result, dict) and result.get("done"):
+                    _drop_stream_hold(prefix, pin, rid)
             else:
                 result, tag = handle.call_http_sync_tagged(
                     body, timeout=call_timeout, pin=pin)
@@ -278,8 +307,23 @@ class _Handler(BaseHTTPRequestHandler):
                             payload.get("priority") or "interactive"),
                         deadline_ms=payload.get("deadline_ms"),
                         adapter_id=payload.get("adapter_id"))
+                if (action == "submit" and quota_hold is not None
+                        and isinstance(result, dict)
+                        and "request_id" in result):
+                    # the quota unit now belongs to the STREAM: polls
+                    # release it when they observe the stream's end
+                    with _state.lock:
+                        _state.tenant_streams[
+                            (prefix, tag, int(result["request_id"]))
+                        ] = quota_hold
+                    quota_hold = None
             self._respond(200, _to_jsonable(result),
                           headers={REPLICA_HEADER: tag})
+        except QuotaExceededError as e:
+            # per-tenant quota, not capacity: 429 tells THIS client to
+            # slow down (a 503 would suggest the fleet is the problem)
+            self._respond(429, {"error": f"QuotaExceededError: {e}"},
+                          headers={"Retry-After": f"{e.retry_after_s:g}"})
         except AdmissionShedError as e:
             self._respond(503, {"error": f"AdmissionShedError: {e}"},
                           headers={"Retry-After": f"{e.retry_after_s:g}"})
@@ -295,8 +339,13 @@ class _Handler(BaseHTTPRequestHandler):
             # drain refusal (replica retiring mid-rollout) are the same
             # "retry later, nothing is broken" contract as zero live
             # replicas — 503, not 500
-            if e.cause_repr.startswith(("EngineOverloadedError",
-                                        "EngineDrainingError")):
+            if e.cause_repr.startswith("QuotaExceededError"):
+                # a quota shed raised behind the actor boundary keeps the
+                # 429 contract of the proxy-side check
+                self._respond(429, {"error": e.cause_repr},
+                              headers={"Retry-After": "1"})
+            elif e.cause_repr.startswith(("EngineOverloadedError",
+                                          "EngineDrainingError")):
                 self._respond(503, {"error": e.cause_repr})
             elif e.cause_repr.startswith("DeadlineExceededError"):
                 # a deadline expiry raised replica-side (queue sweep /
@@ -318,9 +367,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(400, {"error": f"ValueError: {e}"})
         except Exception as e:  # noqa: BLE001 — surface the error to the client
             self._respond(500, {"error": f"{type(e).__name__}: {e}"})
+        finally:
+            if quota_hold is not None:
+                # blocking call, or any path that never handed the unit to
+                # a stream: the request is over, return the unit
+                quota_hold[0].release(quota_hold[1])
 
     do_POST = _dispatch
     do_GET = _dispatch
+
+
+def _drop_stream_hold(prefix: str, pin: Optional[str], request_id) -> None:
+    """Release the tenant quota unit held by a finished (or terminally
+    failed) stream.  Idempotent — re-polls of a done stream pop nothing."""
+    try:
+        key = (prefix, pin or "", int(request_id))
+    except (TypeError, ValueError):
+        return
+    with _state.lock:
+        held = _state.tenant_streams.pop(key, None)
+    if held is not None:
+        held[0].release(held[1])
 
 
 def run(
@@ -388,16 +455,24 @@ def run(
                 _state.server, _state.thread, _state.port = server, thread, port
             old = _state.routes.get(prefix)
             old_scaler = _state.autoscalers.pop(prefix, None)
+            old_watcher = _state.watchers.pop(prefix, None)
             _state.routes[prefix] = handle
             _state.admission[prefix] = AdmissionController(
                 handle, admission_policy)
             if scaler is not None:
                 _state.autoscalers[prefix] = scaler.start()
+            # preemption watcher: polls replicas for lease-revocation
+            # notices and orchestrates migrate-or-replay (supervisor.py)
+            _state.watchers[prefix] = PreemptionWatcher(
+                handle, _state.journal, prefix,
+                autoscaler=_state.autoscalers.get(prefix)).start()
     except Exception:  # noqa: BLE001 — ANY failure past replica start must release them
         _retire(handle)  # deployment failed after replicas started
         raise
     if old_scaler is not None:
         old_scaler.stop()  # must not keep scaling the retired handle
+    if old_watcher is not None:
+        old_watcher.stop()
     if old is not None:
         # Redeploy on an existing route: retire the previous deployment's
         # replicas so their actor processes and chip leases are released.
@@ -438,10 +513,14 @@ def rollout(route_prefix: str = "/", timeout: float = 120.0) -> int:
 def shutdown() -> None:
     """Stop the proxy, the control loops, and every replica actor."""
     with _state.lock:
+        for watcher in _state.watchers.values():
+            watcher.stop()
+        _state.watchers.clear()
         for scaler in _state.autoscalers.values():
             scaler.stop()
         _state.autoscalers.clear()
         _state.admission.clear()
+        _state.tenant_streams.clear()
         for handle in _state.routes.values():
             _retire(handle)
         _state.routes.clear()
@@ -461,12 +540,27 @@ def replica_engine_stats() -> Dict[str, Dict[str, Any]]:
     so replica-side engines are visible beyond the driver's own registry."""
     with _state.lock:
         handles = list(_state.routes.values())
+        controllers = dict(_state.admission)
     out: Dict[str, Dict[str, Any]] = {}
     for handle in handles:
         try:
             out.update(handle.engine_stats())
         except Exception:  # noqa: BLE001 — scrape is best-effort
             continue
+    # proxy-side per-tenant quota sheds ride the ENGINE metric families
+    # (``priority.<class>.quota_shed``): a synthetic partial snapshot per
+    # route sums into the fleet view via merge_snapshots and renders as
+    # tpu_air_engine_priority_quota_shed — both consumers key-guard, so
+    # the missing engine gauges are simply absent, not zero
+    for prefix, controller in controllers.items():
+        qs = controller.stats()["quota_shed"]
+        if any(qs.values()):
+            name = f"admission{prefix.rstrip('/') or '/'}"
+            out[name] = {
+                "name": name,
+                "priority": {p: {"quota_shed": int(n)}
+                             for p, n in qs.items() if n},
+            }
     return out
 
 
@@ -477,6 +571,7 @@ def serve_control_stats() -> Dict[str, Any]:
     with _state.lock:
         controllers = dict(_state.admission)
         scalers = dict(_state.autoscalers)
+        watchers = dict(_state.watchers)
         journal = _state.journal
     out: Dict[str, Any] = {
         prefix: {
@@ -488,8 +583,14 @@ def serve_control_stats() -> Dict[str, Any]:
     }
     # self-healing counters (route prefixes always start with "/", so the
     # bare key can't collide): journal size, replays, replay failures, and
-    # the installed fault plan's injection ledger (docs/RESILIENCE.md)
-    out["recovery"] = {**journal.stats(), "faults": _faults.stats()}
+    # the installed fault plan's injection ledger (docs/RESILIENCE.md);
+    # preemption-migration counters sum across routes' watchers
+    preempt: Dict[str, int] = {}
+    for watcher in watchers.values():
+        for k, v in watcher.stats().items():
+            preempt[k] = preempt.get(k, 0) + int(v)
+    out["recovery"] = {**journal.stats(), **preempt,
+                       "faults": _faults.stats()}
     # live-weight canary controllers (serve/weights.py): per-route state
     # machine, promotions/rollbacks, gate failures with reasons
     try:

@@ -49,7 +49,8 @@ from tpu_air.observability import tracing as _tracing
 
 from .deployment import NoLiveReplicasError, ReplicaGoneError
 
-__all__ = ["JournalEntry", "RequestJournal", "journaled_poll"]
+__all__ = ["JournalEntry", "PreemptionWatcher", "RequestJournal",
+           "journaled_poll"]
 
 
 @dataclass(eq=False)
@@ -85,6 +86,10 @@ class RequestJournal:
             OrderedDict())
         self.replays = 0
         self.replay_failures = 0
+        # cap evictions that had to take a LIVE (not-done, not-redirected)
+        # entry — each one is a stream that silently lost its replay
+        # safety net, so the counter surfaces on /-/stats recovery
+        self.evicted_live = 0
 
     # -- bookkeeping (proxy handler threads) --------------------------------
     def record_submit(self, prefix: str, pin: str, request_id: int, *,
@@ -103,7 +108,20 @@ class RequestJournal:
         with self._lock:
             self._entries[(prefix, pin, int(request_id))] = entry
             while len(self._entries) > self._cap:
-                self._entries.popitem(last=False)
+                self._evict_one_locked()
+
+    def _evict_one_locked(self) -> None:
+        """Drop one entry to make room, preferring the oldest FINISHED
+        one (done, or fully delivered) — blind FIFO used to evict the
+        oldest entry even while its stream was live, silently discarding
+        its replay safety net.  Only when every entry is live does the
+        cap force a live eviction, and that is counted."""
+        for key, e in self._entries.items():
+            if e.done:
+                del self._entries[key]
+                return
+        self.evicted_live += 1
+        self._entries.popitem(last=False)
 
     def lookup(self, prefix: str, pin: Optional[str],
                request_id: int) -> Optional[JournalEntry]:
@@ -121,12 +139,24 @@ class RequestJournal:
             entry.tokens = list(tokens)
             entry.done = bool(done)
 
+    def repin(self, entry: JournalEntry, new_pin: str,
+              new_request_id: int) -> None:
+        """Migration re-pin: the stream continues on ``new_pin`` under
+        ``new_request_id``.  Unlike a replay redirect, the destination
+        engine force-emits every already-streamed token before resuming
+        decode, so the continuation stream carries the FULL client-visible
+        list — the redirect offset is 0 and no journal prefix is
+        stitched in front of it."""
+        with entry.lock:
+            entry.redirect = (str(new_pin), int(new_request_id), 0)
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
                 "journal_size": len(self._entries),
                 "replays": self.replays,
                 "replay_failures": self.replay_failures,
+                "journal_evicted_live": self.evicted_live,
             }
 
     # -- recovery ------------------------------------------------------------
@@ -245,6 +275,211 @@ def journaled_poll(journal: RequestJournal, handle, prefix: str,
     if entry is not None:
         journal.record_progress(entry, toks, done)
     return {"tokens": toks[cursor:], "done": done}, tag
+
+
+class PreemptionWatcher:
+    """Driver-side preemption orchestration for one route.
+
+    A daemon thread polls every replica's ``preempt_status`` (cheap — it
+    never forces an engine build).  When a replica reports a revocation
+    notice the watcher, in order:
+
+    1. signals the autoscaler (``notice_scale_up`` on a side thread —
+       capacity is ANNOUNCED to leave, no gauge needed, and the blocking
+       spawn must not eat the notice window);
+    2. if enough notice remains, MIGRATES: ``migrate_out`` freezes the
+       source and returns one payload per live decoding slot;
+       ``submit_migrated`` lands each on a survivor, and the journal
+       entry is re-pinned so the client's next poll reads the
+       destination stream (token-identical, zero re-prefill);
+    3. falls back to the PR 13 journal REPLAY for anything it could not
+       migrate (notice too short, no survivor, payload rejected): taking
+       the source out of rotation makes the next pinned poll raise
+       ``ReplicaGoneError``, which ``journaled_poll`` already recovers;
+    4. takes the source out of rotation either way — its chips are gone
+       at the end of the window whether or not anyone drained.
+    """
+
+    def __init__(self, handle, journal: RequestJournal, prefix: str, *,
+                 autoscaler=None, poll_s: float = 0.2,
+                 min_migrate_notice_s: float = 0.5,
+                 migrate_timeout_s: float = 30.0):
+        self._handle = handle
+        self._journal = journal
+        self._prefix = prefix
+        self._autoscaler = autoscaler
+        self.poll_s = float(poll_s)
+        self.min_migrate_notice_s = float(min_migrate_notice_s)
+        self.migrate_timeout_s = float(migrate_timeout_s)
+        self._lock = threading.Lock()
+        self.preemptions = 0
+        self.migrations = 0
+        self.migrated_pages = 0
+        self.migration_fallbacks = 0
+        #: worst orchestration wall time (notice observed -> replica out of
+        #: rotation): the window during which the doomed replica's streams
+        #: are being re-seated — the bench's ``preemption_recovery_ms``
+        self.preemption_recovery_ms = 0.0
+        self._handled: set = set()  # replica tags already orchestrated
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- replica RPC plumbing -------------------------------------------------
+    @staticmethod
+    def _call(replica, method: str, *args, timeout: float = 30.0):
+        from tpu_air.core import api as core_api
+
+        return core_api.get(
+            replica.handle.remote(method, tuple(args), {}), timeout=timeout)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "PreemptionWatcher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"preemption-watcher-{self._prefix}")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the watcher must outlive any one bad tick
+                pass
+
+    # -- one poll round -------------------------------------------------------
+    def tick(self) -> None:
+        with self._handle._lock:
+            replicas = list(self._handle._replicas)
+        for replica in replicas:
+            tag = replica._actor_id
+            # airlint: disable=CC001 — _handled is confined to the single
+            # watcher thread (tick + the set below); never read elsewhere
+            if tag in self._handled:
+                continue
+            try:
+                status = self._call(replica, "preempt_status", timeout=5.0)
+            except Exception:  # noqa: BLE001 — dead/foreign replicas just aren't preempting
+                continue
+            if not (status or {}).get("preempting"):
+                continue
+            self._handled.add(tag)
+            self._orchestrate(replica, status)
+
+    def _orchestrate(self, replica, status: Dict[str, Any]) -> None:
+        tag = replica._actor_id
+        t_start = time.monotonic()
+        with self._lock:
+            self.preemptions += 1
+        if self._autoscaler is not None:
+            threading.Thread(  # blocking spawn: keep it off the notice clock
+                target=self._notice_autoscaler, daemon=True,
+                name=f"preemption-scale-up-{self._prefix}").start()
+        notice_left = float(status.get("notice_left_s") or 0.0)
+        with _tracing.span("serve.migrate", attrs={
+                "from": tag, "notice_s": status.get("notice_s"),
+                "notice_left_s": notice_left}):
+            migrated_all = False
+            if notice_left >= self.min_migrate_notice_s:
+                migrated_all = self._migrate(replica)
+            if not migrated_all:
+                with self._lock:
+                    self.migration_fallbacks += 1
+        # out of rotation LAST: while migration runs, pinned polls still
+        # reach the frozen source and serve correct (stale) prefixes.
+        # After this, un-migrated streams' polls raise ReplicaGoneError
+        # and journaled_poll replays them on a survivor.
+        self._handle.mark_dead(replica)
+        with self._lock:
+            self.preemption_recovery_ms = max(
+                self.preemption_recovery_ms,
+                (time.monotonic() - t_start) * 1000.0)
+        # the serve plane took everything it wants from the zombie
+        # (payloads migrated, pollers re-pinned or replaying): terminate
+        # it so its chips return to the pool — the preempted capacity must
+        # be re-leasable, not leaked to a drained husk
+        try:
+            from tpu_air.core.runtime import get_runtime
+
+            get_runtime().kill_actor(tag)
+        except Exception:  # noqa: BLE001 — best-effort reclaim of a dying actor
+            pass
+
+    def _notice_autoscaler(self) -> None:
+        try:
+            self._autoscaler.notice_scale_up()
+        except Exception:  # noqa: BLE001 — a failed spawn must not kill the watcher
+            pass
+
+    @staticmethod
+    def _payload_pages(payload: Dict[str, Any]) -> int:
+        first = next(iter((payload.get("pages") or {}).values()), None)
+        try:
+            return int(first["k"].shape[0]) if first else 0
+        except Exception:  # noqa: BLE001 — page count is observability, not control flow
+            return 0
+
+    def _migrate(self, source) -> bool:
+        """Drain ``source``'s live slots onto survivors.  True only when
+        EVERY payload landed (an empty payload list counts — nothing was
+        decoding); anything less lets the caller count a fallback and the
+        stranded streams take the replay path."""
+        tag = source._actor_id
+        try:
+            payloads = self._call(source, "migrate_out",
+                                  timeout=self.migrate_timeout_s)
+        except Exception:  # noqa: BLE001 — a frozen/dying source means replay for everyone
+            return False
+        with self._handle._lock:
+            survivors = [r for r in self._handle._replicas
+                         if r._actor_id != tag]
+        if not survivors and payloads:
+            return False
+        ok = True
+        for i, payload in enumerate(payloads):
+            placed = False
+            # spread migrated streams across survivors round-robin; on a
+            # rejected payload (KVTransferError crossing as RemoteError)
+            # try the next survivor before giving the stream to replay
+            for j in range(len(survivors)):
+                dest = survivors[(i + j) % len(survivors)]
+                try:
+                    new_rid = self._call(dest, "submit_migrated", payload,
+                                         timeout=self.migrate_timeout_s)
+                except Exception:  # noqa: BLE001 — rejected here ≠ lost: replay covers it
+                    continue
+                entry = self._journal.lookup(
+                    self._prefix, tag, int(payload.get("request_id", -1)))
+                if entry is not None:
+                    self._journal.repin(entry, dest._actor_id, new_rid)
+                with self._lock:
+                    self.migrations += 1
+                    self.migrated_pages += self._payload_pages(payload)
+                placed = True
+                break
+            if not placed:
+                ok = False
+        return ok
+
+    # -- observability --------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "preemptions": self.preemptions,
+                "migrations": self.migrations,
+                "migrated_pages": self.migrated_pages,
+                "migration_fallbacks": self.migration_fallbacks,
+                "preemption_recovery_ms": round(
+                    self.preemption_recovery_ms, 3),
+            }
 
 
 def _poll_redirected(journal: RequestJournal, handle, entry: JournalEntry,

@@ -39,6 +39,32 @@ def _default_storage() -> str:
     )
 
 
+def _touch(path: str) -> None:
+    try:
+        with open(path, "w") as f:
+            f.write("revoked")
+    except OSError:
+        pass
+
+
+def _shrunk_scaling(sc: ScalingConfig, chips_per_host: int):
+    """The next-smaller legal lease shape after a preemption: halve the
+    DATA-parallel degree (the model/sequence axes must survive intact — a
+    TP-sharded model cannot lose chips) until the total is a legal lease
+    shape (whole-host multiples above one host).  ``None`` when already at
+    one worker — the run cannot shrink further and must wait for the same
+    shape to free up."""
+    import dataclasses
+
+    workers = sc.num_workers
+    while workers > 1:
+        workers //= 2
+        total = workers * sc.num_chips_per_worker
+        if total <= chips_per_host or total % chips_per_host == 0:
+            return dataclasses.replace(sc, num_workers=workers)
+    return None
+
+
 def _scan_latest_checkpoint(run_dir: str):
     """Newest ``checkpoint_*`` directory under ``run_dir`` as the
     ``(path, metrics)`` pair the session would have reported.  The recovery
@@ -217,7 +243,6 @@ class BaseTrainer:
     ) -> Result:
         sc = self.scaling_config
         rc = self.run_config
-        max_failures = rc.failure_config.max_failures
         resume = self.resume_from_checkpoint
         config = dict(self._train_loop_config())
         if extra_config:
@@ -239,8 +264,18 @@ class BaseTrainer:
             and (sc.total_chips or 0) > rt.chips_per_host
         ):
             return self._run_spmd_multihost(
-                datasets, run_dir, config, cluster, rt, resume
+                datasets, run_dir, config, cluster, rt, resume,
+                trial_id=trial_id,
             )
+        return self._run_actor_attempts(
+            datasets, run_dir, trial_id, config, resume, sc, rc
+        )
+
+    def _run_actor_attempts(self, datasets, run_dir, trial_id, config,
+                            resume, sc, rc) -> Result:
+        """The single-actor attempt loop (also the landing path when an
+        elastic preemption shrinks a multihost lease down to one host)."""
+        max_failures = rc.failure_config.max_failures
         attempt = 0
         while True:
             if resume is not None:
@@ -286,7 +321,7 @@ class BaseTrainer:
             )
 
     def _run_spmd_multihost(
-        self, datasets, run_dir, config, cluster, rt, resume
+        self, datasets, run_dir, config, cluster, rt, resume, trial_id=None
     ) -> Result:
         """Run the training fn on EVERY host of the active cluster in
         lockstep over a cross-host chip lease.  Host 0 (this process) keeps
@@ -297,18 +332,68 @@ class BaseTrainer:
         (exceptions inside the training fn): retry from the latest
         checkpoint up to ``max_failures``.  Infrastructure failures (a dead
         host agent) propagate — the same dead cluster would fail every
-        retry."""
+        retry.
+
+        ELASTIC preemption (docs/RESILIENCE.md): a revoked chip lease —
+        cold (``LeaseRevokedError`` at acquisition) or graceful (a notice
+        mid-trial, observed by every host's session at its next report) —
+        is not a training failure.  The run checkpoint-retains as usual,
+        re-leases at a possibly SMALLER data-parallel width (capacity just
+        left the pool), and resumes from the newest retained checkpoint.
+        Preemption retries are budgeted separately from ``max_failures``
+        so a preempted trial does not burn its crash-recovery budget."""
+        from tpu_air.faults.plan import LeaseRevokedError
+
         sc = self.scaling_config
         rc = self.run_config
         max_failures = rc.failure_config.max_failures
+        max_preemptions = 3
         attempt = 0
+        preemptions = 0
+        marker = os.path.join(run_dir, "_lease_revoked")
+
+        def shrink_and_resume(latest):
+            nonlocal sc, resume
+            smaller = _shrunk_scaling(sc, rt.chips_per_host)
+            if smaller is not None:
+                sc = smaller
+                config["_scaling_config"] = sc
+            if latest:
+                resume = Checkpoint.from_directory(latest[0])
+
         while True:
+            if sc.total_chips <= rt.chips_per_host:
+                # the elastic shrink landed on a single host: the agent
+                # plane is the wrong vehicle now (the lease no longer
+                # spans hosts) — finish the run on the actor path
+                return self._run_actor_attempts(
+                    datasets, run_dir, trial_id, config, resume, sc,
+                    self.run_config
+                )
             if resume is not None:
                 config["resume_from_checkpoint"] = (
                     resume.to_directory()
                     if isinstance(resume, Checkpoint) else resume
                 )
-            lease = rt.lease_chips(sc.total_chips, timeout=300.0)
+            try:
+                os.remove(marker)
+            except OSError:
+                pass
+            try:
+                lease = rt.lease_chips(sc.total_chips, timeout=300.0)
+            except LeaseRevokedError:
+                # cold revocation at acquisition: nothing ran, nothing is
+                # lost — re-lease smaller and resume
+                if preemptions >= max_preemptions:
+                    raise
+                preemptions += 1
+                shrink_and_resume(_scan_latest_checkpoint(run_dir))
+                continue
+            # graceful preemption: the notice writes the marker (run_dir
+            # is on shared storage), every host's session sees it at its
+            # next report and raises LeaseRevokedError out of the loop at
+            # the SAME iteration — an SPMD-consistent stop point
+            lease.on_revoke(lambda notice_s, _m=marker: _touch(_m))
             try:
                 out, error = self._run_spmd_leased(
                     datasets, run_dir, config, cluster, rc, sc, lease
@@ -318,6 +403,11 @@ class BaseTrainer:
             if error is None:
                 return self._assemble(out, run_dir, config, None)
             latest = out.get("latest_checkpoint")
+            if (lease.revoking and "LeaseRevokedError" in str(error)
+                    and preemptions < max_preemptions):
+                preemptions += 1
+                shrink_and_resume(latest)
+                continue
             if attempt < max_failures:
                 attempt += 1
                 if latest:
@@ -350,6 +440,23 @@ class BaseTrainer:
             pid = jax.process_index()
             prev_lease = os.environ.get("TPU_AIR_CHIP_IDS")
             os.environ["TPU_AIR_CHIP_IDS"] = ",".join(str(c) for c in lease)
+
+            # graceful-preemption stop point: the driver's on_revoke hook
+            # touches this marker; every host checks it at report() — the
+            # same iteration on every host, so the SPMD program counters
+            # never diverge — and unwinds with LeaseRevokedError, which
+            # _run_spmd_multihost treats as "shrink + resume", not failure
+            marker = os.path.join(run_dir, "_lease_revoked")
+
+            def _preempt_check(rec, seq, _m=marker):
+                if os.path.exists(_m):
+                    from tpu_air.faults.plan import LeaseRevokedError
+
+                    raise LeaseRevokedError(
+                        "chip lease revoked mid-trial (preemption notice)"
+                    )
+                return True
+
             try:
                 ds = {k: _BroadcastDataset(df) for k, df in dfs.items()}
                 rd = run_dir if pid == 0 else tempfile.mkdtemp(
@@ -359,6 +466,7 @@ class BaseTrainer:
                     run_dir=rd, checkpoint_config=ckpt_cfg, datasets=ds,
                     config=config, world_size=world,
                     sinks=None if pid == 0 else [],
+                    decision_cb=_preempt_check,
                 )
                 _set_active(session)
                 out = {"error": None, "stopped": False}
