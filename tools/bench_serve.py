@@ -52,6 +52,14 @@ bench-harness noise — hundreds of client threads share this process's
 GIL) and ENGINE-recorded (submit → first token inside the serving plane;
 the headline ratio reads this one), plus phase tokens/s.
 
+The whole run executes with airwatch installed (observability/watch.py):
+the driver-side FleetScraper rides along exactly as it would in
+production, and its per-tenant cost ledger yields the ``cost`` section —
+``chip_seconds_per_1k_tokens`` (attributed busy chip-seconds per 1k
+tokens, the $/token proxy) and the per-tenant token split.  Bench
+traffic carries no ``adapter_id``, so every token must land on the
+``default`` tenant (``cost.tenants.default.token_share`` pins 1.0).
+
 Honest CPU caveat: on XLA:CPU a decode step costs ~2-3 ms dispatch, so
 absolute TTFTs here are noise-dominated; what transfers to TPU is the
 SHAPE — shed ordering (best_effort first, interactive never) and the
@@ -338,6 +346,7 @@ def main():
     from tpu_air.engine import EngineConfig
     from tpu_air.models.lm import CausalLM, LMConfig
     from tpu_air.observability import tracing
+    from tpu_air.observability import watch as watch_mod
     from tpu_air.serve import AdmissionPolicy, EngineDeployment
     from tpu_air.train import Checkpoint
 
@@ -362,6 +371,12 @@ def main():
 
     tpu_air.init(num_cpus=4, num_chips=8)
     tracing.enable()
+    # airwatch rides along for the whole run: serve.run starts the
+    # FleetScraper against each phase's deployment, and the cost ledger
+    # accumulates per-tenant attribution across phases (counter resets at
+    # phase boundaries re-baseline without attributing negative deltas)
+    fleet_watch = watch_mod.install(watch_mod.WatchConfig(
+        interval_s=0.5, seed=args.seed))
     result = {
         "bench": "serve_slo_open_loop",
         "config": {
@@ -459,26 +474,35 @@ def main():
         serve.shutdown()
         tpu_air.shutdown()
         tpu_air.init(num_cpus=4, num_chips=8)
-        # delay_s counts from the replica's lease ATTACH (deploy time), so
-        # mid-duration leaves margin for warmup jitter before the notice
+        # delay_s counts from the replica's lease ATTACH (deploy time).
+        # Warmup compiles BOTH replicas in parallel (below) and costs a
+        # few seconds of fresh-process XLA compile, so a full duration of
+        # delay lands the notice a few seconds INTO the arrival window —
+        # while the doomed replica has streams decoding (live KV to
+        # migrate).  delay_s = duration/2 used to race the compile: a slow
+        # warmup let the notice fire before any traffic, and the phase
+        # measured a drain of nothing (migrations=0, recovery ~1ms).
         plan = FaultPlan(seed=args.seed, specs=[
             FaultSpec("runtime.lease", "notice", at=1, match="chips=1",
-                      delay_s=args.duration / 2.0, notice_s=60.0)])
+                      delay_s=args.duration, notice_s=60.0)])
         # max_restarts=0: this phase measures the DRAIN + MIGRATE cost, not
         # replacement-spawn cost — and a respawn would re-lease the revoked
         # chip (lowest free id) in a fresh process whose per-process fault
         # counter re-fires the seeded notice, turning the phase into a
-        # preemption loop.  Longer streams (max_new 80, slot_len 96): on
-        # CPU a 12-token stream lives ~40 ms, so the notice instant would
-        # usually catch nothing in flight; ~80-token streams keep the
-        # slots occupied so the drain has live KV state to move.  Half
-        # background rate: the survivor must stay shallow-queued after
-        # capacity halves — queued (not-yet-decoding) streams can only be
-        # rescued by replay, and a deep post-kill queue admission-sheds
-        # best_effort replays, polluting the migrate-vs-replay signal.
-        preempt_max_new = max(args.max_new, 80)
+        # preemption loop.  Long streams (max_new 320, slot_len 336): on
+        # CPU a decode step costs ~2-3 ms, so a 12-token stream lives
+        # ~40 ms and even an 80-token one ~0.25 s — at these arrival
+        # rates the notice instant would catch a live slot on the doomed
+        # replica only by luck.  ~320-token streams live ~1 s, which
+        # keeps expected occupancy ≥1 slot per replica so the drain has
+        # live KV state to move.  Half background rate: the survivor must
+        # stay shallow-queued after capacity halves — queued
+        # (not-yet-decoding) streams can only be rescued by replay, and a
+        # deep post-kill queue admission-sheds best_effort replays,
+        # polluting the migrate-vs-replay signal.
+        preempt_max_new = max(args.max_new, 320)
         preempt_cfg = EngineConfig(
-            num_slots=engine_cfg.num_slots, slot_len=96,
+            num_slots=engine_cfg.num_slots, slot_len=336,
             max_new_tokens=preempt_max_new, max_queue=engine_cfg.max_queue,
             reserved_interactive_slots=engine_cfg.reserved_interactive_slots,
         )
@@ -491,13 +515,36 @@ def main():
             admission_policy=policy,
             fault_plan=plan,
         )
-        _post("/engine", {"prompt": prompts[0], "priority": "batch",
-                          "max_new_tokens": preempt_max_new}, timeout=300.0)
+        # warm up BOTH replicas in parallel (replicas are separate worker
+        # processes — each compiles its own prefill/decode programs for
+        # the preempt shapes).  The handle round-robins idle replicas and
+        # counts its own in-flight calls, so two concurrent blocking
+        # generates land on different replicas; serially they would
+        # compile back-to-back and push the phase past the lease notice.
+        warm_threads = [
+            threading.Thread(
+                target=_post,
+                args=("/engine", {"prompt": prompts[0], "priority": "batch",
+                                  "max_new_tokens": preempt_max_new}),
+                kwargs={"timeout": 300.0}, daemon=True)
+            for _ in range(2)]
+        t_warm = time.monotonic()
+        warm_threads[0].start()
+        time.sleep(0.2)
+        warm_threads[1].start()
+        for th_w in warm_threads:
+            th_w.join(timeout=300.0)
+        warmup_s = round(time.monotonic() - t_warm, 3)
         result["preemption"] = _run_phase(args.interactive_rps,
                                           args.underload_rps / 2.0,
                                           args.duration,
                                           prompts, preempt_max_new, rng)
         rec = serve_control_stats().get("recovery") or {}
+        # warmup wall vs the notice delay: the notice fires delay_s after
+        # lease attach, so (delay_s - warmup_s) is how far INTO the
+        # arrival window it landed — diagnostic for a run where the drain
+        # caught nothing live
+        result["preemption"]["warmup_s"] = warmup_s
         result["preemption"]["recovery"] = {
             k: rec.get(k) for k in (
                 "preemptions", "migrations", "migrated_pages",
@@ -532,9 +579,42 @@ def main():
         result["interactive_shed_total"] = (
             result["underload"]["classes"]["interactive"]["shed"]
             + over["shed"])
+
+        # -- airwatch cost attribution over the whole run -----------------
+        # one final synchronous scrape closes the last attribution
+        # interval, then the ledger's fleet headline becomes the bench's
+        # $/token proxy: attributed busy chip-seconds per 1k tokens
+        fleet_watch.scrape_once()
+        led = fleet_watch.ledger.snapshot()
+        head = led.get("headline") or {}
+        result["cost"] = {
+            "chip_seconds_per_1k_tokens": round(
+                float(head.get("chip_seconds_per_1k_tokens", 0.0)), 4),
+            "chip_seconds_attributed": round(
+                float(head.get("chip_seconds_attributed", 0.0)), 3),
+            "idle_chip_seconds": round(
+                float(led.get("idle_chip_seconds", 0.0)), 3),
+            "tokens_total": round(float(head.get("tokens_total", 0.0)), 1),
+            "intervals": int(led.get("intervals", 0)),
+            "watch_scrapes": int(fleet_watch.scrapes),
+            "watch_anomalies": len(fleet_watch.events(kind="watch.anomaly")),
+            "tenants": {
+                name: {
+                    "tokens_total": round(
+                        float(t.get("tokens_total", 0.0)), 1),
+                    "token_share": round(float(t.get("token_share", 0.0)), 4),
+                    "chip_seconds": round(
+                        float(t.get("chip_seconds", 0.0)), 3),
+                    "chip_seconds_per_1k_tokens": round(
+                        float(t.get("chip_seconds_per_1k_tokens", 0.0)), 4),
+                }
+                for name, t in (led.get("tenants") or {}).items()
+            },
+        }
     finally:
         serve.shutdown()
         tpu_air.shutdown()
+        watch_mod.clear()
         from tpu_air import faults as _faults
 
         _faults.clear()

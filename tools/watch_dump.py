@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""watch_dump — query the airwatch plane (observability/watch.py) off a
+running dashboard, or the local process's installed Watch.
+
+Usage::
+
+    # fleet summary: scrape counters, anomalies, tenant cost headline
+    python tools/watch_dump.py --url http://127.0.0.1:8265
+
+    # per-tenant cost ledger only
+    python tools/watch_dump.py --url http://127.0.0.1:8265 --tenants
+
+    # recent watch.anomaly / note events (with trace exemplars)
+    python tools/watch_dump.py --url http://127.0.0.1:8265 --events
+
+    # one metric's time series from a downsampling tier
+    python tools/watch_dump.py --metric fleet.tokens_per_s --step 10
+
+    # machine-readable: the raw JSON payloads instead of the text report
+    python tools/watch_dump.py --json
+
+    # no dashboard: read THIS process's installed Watch (scripts that
+    # import tpu_air, install airwatch, run work, then exec this file)
+    python tools/watch_dump.py --local
+
+See docs/OBSERVABILITY.md ("airwatch") for the data model.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fetch(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _local_payloads(metric=None, step=None):
+    from tpu_air.observability import watch as watch_mod
+
+    w = watch_mod.current()
+    if w is None:
+        return {"enabled": False}, {"enabled": False, "tenants": {}}, []
+    series = (w.store.series(metric, step=step)
+              if metric and metric in w.store.metrics() else [])
+    return w.payload(), {"enabled": True, **w.ledger.snapshot()}, series
+
+
+def render_events(events, out=sys.stdout) -> None:
+    w = out.write
+    if not events:
+        w("no events recorded\n")
+        return
+    for e in events:
+        kind = e.get("event", "?")
+        if kind == "watch.anomaly":
+            w(f"[{e.get('ts', 0):.1f}] ANOMALY {e['metric']}: "
+              f"value={e.get('value', 0):.4g} mean={e.get('mean', 0):.4g} "
+              f"z={e.get('zscore', 0):.2f} (threshold {e.get('threshold', 0):.2f}, "
+              f"window {e.get('window_s', 0):g}s)")
+            if e.get("trace_exemplar"):
+                w(f"  trace={e['trace_exemplar']}")
+            w("\n")
+        else:
+            attrs = {k: v for k, v in e.items() if k not in ("event", "ts")}
+            w(f"[{e.get('ts', 0):.1f}] {kind}: "
+              + " ".join(f"{k}={v}" for k, v in sorted(attrs.items())) + "\n")
+
+
+def render_tenants(ledger, out=sys.stdout) -> None:
+    w = out.write
+    tenants = ledger.get("tenants") or {}
+    if not tenants:
+        w("no tenant activity attributed yet\n")
+        return
+    head = ledger.get("headline") or {}
+    w(f"{'tenant':<20} {'tokens':>10} {'share':>7} {'chip_s':>10} "
+      f"{'cs/1k tok':>10} {'kv_page_s':>10} {'sheds':>6} {'quota':>6}\n")
+    for name, t in sorted(tenants.items(),
+                          key=lambda kv: -kv[1].get("tokens_total", 0)):
+        w(f"{name:<20} {t.get('tokens_total', 0):>10.0f} "
+          f"{t.get('token_share', 0):>7.2%} "
+          f"{t.get('chip_seconds', 0):>10.2f} "
+          f"{t.get('chip_seconds_per_1k_tokens', 0):>10.3f} "
+          f"{t.get('kv_page_seconds', 0):>10.1f} "
+          f"{t.get('sheds', 0):>6.0f} {t.get('quota_rejected', 0):>6.0f}\n")
+    w(f"\nheadline: {head.get('tokens_total', 0):.0f} tokens, "
+      f"{head.get('chip_seconds_attributed', 0):.2f} attributed chip-s "
+      f"({ledger.get('idle_chip_seconds', 0):.2f} idle) -> "
+      f"{head.get('chip_seconds_per_1k_tokens', 0):.3f} chip-s per 1k tokens"
+      f" over {ledger.get('intervals', 0)} intervals\n")
+
+
+def render_summary(payload, ledger, out=sys.stdout) -> None:
+    w = out.write
+    if not payload.get("enabled"):
+        w("airwatch is not installed on the target "
+          "(call observability.watch.install())\n")
+        return
+    cfg = payload.get("config") or {}
+    store = payload.get("store") or {}
+    w(f"airwatch: {payload.get('scrapes', 0)} scrapes @ "
+      f"{cfg.get('interval_s', 0):g}s, seed={cfg.get('seed')}, "
+      f"ttl={cfg.get('ttl_s', 0):g}s\n")
+    w(f"store: {store.get('metrics', 0)} metrics, "
+      f"{store.get('samples_recorded', 0)} samples, "
+      f"{store.get('buckets_resident', 0)} buckets over tiers "
+      f"{store.get('tiers')}\n")
+    w(f"anomalies: {payload.get('anomalies', 0)} total\n")
+    det = payload.get("detector") or {}
+    for metric, st in sorted(det.items()):
+        w(f"  {metric:<28} mean={st.get('mean', 0):>10.4g} "
+          f"dev={st.get('deviation', 0):>9.4g} n={st.get('samples', 0):>5} "
+          f"z*={st.get('threshold', 0):.2f}\n")
+    anomalies = [e for e in payload.get("events") or []
+                 if e.get("event") == "watch.anomaly"]
+    if anomalies:
+        w(f"\nrecent anomalies ({len(anomalies)}):\n")
+        render_events(anomalies[-10:], out)
+    w("\n")
+    render_tenants(ledger, out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="http://127.0.0.1:8265",
+                    help="dashboard base URL (default %(default)s)")
+    ap.add_argument("--local", action="store_true",
+                    help="read this process's Watch, no dashboard needed")
+    ap.add_argument("--tenants", action="store_true",
+                    help="print only the per-tenant cost ledger")
+    ap.add_argument("--events", action="store_true",
+                    help="print only the recent event ring")
+    ap.add_argument("--metric", default=None,
+                    help="print one metric's series (e.g. fleet.tokens_per_s)")
+    ap.add_argument("--step", type=float, default=None,
+                    help="tier step in seconds for --metric (default finest)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit raw JSON instead of the text report")
+    args = ap.parse_args(argv)
+
+    if args.local:
+        payload, ledger, series = _local_payloads(args.metric, args.step)
+    else:
+        base = args.url.rstrip("/")
+        payload = _fetch(f"{base}/api/watch")
+        ledger = _fetch(f"{base}/api/tenants")
+        series = []
+        if args.metric:
+            # the dashboard serves series through /api/watch's store stats
+            # only; remote per-metric series need --local on the serving
+            # process (the store is driver-side state, not exported raw)
+            print("--metric requires --local (the raw rings live in the "
+                  "serving process)", file=sys.stderr)
+            return 2
+
+    if args.metric and args.local:
+        if args.json:
+            print(json.dumps(series, indent=2))
+        else:
+            for b in series:
+                print(f"ts={b['ts']:<12g} count={b['count']:<5} "
+                      f"last={b['last']:.6g} mean={b['mean']:.6g} "
+                      f"min={b['min']:.6g} max={b['max']:.6g}")
+        return 0
+    if args.json:
+        doc = {"watch": payload, "tenants": ledger}
+        print(json.dumps(doc, indent=2))
+        return 0
+    if args.tenants:
+        render_tenants(ledger)
+        return 0
+    if args.events:
+        render_events(payload.get("events") or [])
+        return 0
+    render_summary(payload, ledger)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

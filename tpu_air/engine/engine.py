@@ -685,6 +685,7 @@ class InferenceEngine:
         self._pos[slot.index] = p
         self.metrics.record_migration(
             "in", len(page_ids), reprefill_chunks=slot.plan.chunks_left)
+        self.metrics.record_tenant_migrated(req.adapter_id, len(page_ids))
         if slot.budget_left == 0 or (
             self.eos_token_id is not None
             and streamed[-1] == self.eos_token_id
@@ -1061,6 +1062,24 @@ class InferenceEngine:
         # saw the stream complete — useful work
         self.metrics.record_goodput(
             "useful", slot.pos - len(slot.request.prompt) + 1)
+        # per-tenant cost attribution (airwatch ledger feed): bill the
+        # stream's tokens and KV-page residency to its adapter_id tenant.
+        # Residency runs from first token (pages are fully resident once
+        # prefill lands) to retirement; page count mirrors the pool's own
+        # ceil-division for paged engines, the fixed slot reservation for
+        # slab engines.
+        req = slot.request
+        if self.paged:
+            n_pages = -(-slot.pos // self.config.page_len)
+        else:
+            n_pages = self.config.pages_per_slot()
+        resident_s = max(
+            0.0, time.monotonic() - (req.first_token_at or req.submitted_at))
+        self.metrics.record_tenant_retire(
+            req.adapter_id,
+            prefilled=len(req.prompt),
+            decoded=slot.pos - len(req.prompt) + 1,
+            kv_page_seconds=n_pages * resident_s)
         if self.paged:
             # private pages return to the free list; prompt pages the prefix
             # cache registered stay resident for future hits

@@ -94,6 +94,10 @@ class EngineMetrics:
         # preemption migration counters (empty until the first migrate —
         # same absent-until-used contract as ``weights``)
         self.migrations: Dict[str, Any] = {}
+        # per-tenant usage counters keyed by adapter_id ("default" for the
+        # base model), recorded at retirement — airwatch's cost-ledger feed
+        # (same absent-until-used contract: empty until the first retire)
+        self.tenants: Dict[str, Dict[str, float]] = {}
         register(self)
 
     def set_topology(self, **kw: Any) -> None:
@@ -167,6 +171,39 @@ class EngineMetrics:
                 mg["in_reprefill_chunks"] = (
                     int(mg.get("in_reprefill_chunks", 0))
                     + int(reprefill_chunks))
+
+    def _tenant(self, adapter_id: Optional[str]) -> Dict[str, float]:
+        """Per-tenant counter dict (call with ``self._lock`` held)."""
+        key = adapter_id if adapter_id else "default"
+        d = self.tenants.get(key)
+        if d is None:
+            d = {"tokens_prefilled": 0, "tokens_decoded": 0,
+                 "requests_completed": 0, "kv_page_seconds": 0.0,
+                 "migrated_pages": 0}
+            self.tenants[key] = d
+        return d
+
+    def record_tenant_retire(self, adapter_id: Optional[str],
+                             prefilled: int, decoded: int,
+                             kv_page_seconds: float) -> None:
+        """One stream retired: bill its prompt/decode tokens and the
+        KV-page residency (pages held × seconds resident) to its tenant
+        (``adapter_id``, or the base-model ``"default"`` tenant).  The
+        airwatch cost ledger differences these cumulative counters per
+        scrape interval (observability/watch.py)."""
+        with self._lock:
+            d = self._tenant(adapter_id)
+            d["requests_completed"] += 1
+            d["tokens_prefilled"] += int(prefilled)
+            d["tokens_decoded"] += int(decoded)
+            d["kv_page_seconds"] += max(0.0, float(kv_page_seconds))
+
+    def record_tenant_migrated(self, adapter_id: Optional[str],
+                               pages: int) -> None:
+        """KV pages shipped on behalf of one tenant's live-slot migration
+        (billed at the landing, where the page count is exact)."""
+        with self._lock:
+            self._tenant(adapter_id)["migrated_pages"] += int(pages)
 
     def record_ttft(self, seconds: float, priority: str = "interactive",
                     trace_id: Optional[str] = None) -> None:
@@ -293,6 +330,9 @@ class EngineMetrics:
                 out["weights"] = dict(self.weights)
             if self.migrations:
                 out["migrations"] = dict(self.migrations)
+            if self.tenants:
+                out["tenants"] = {t: dict(d)
+                                  for t, d in self.tenants.items()}
         out["tokens_per_s"] = self.tokens_per_s()
         return out
 
@@ -355,6 +395,17 @@ def merge_snapshots(snapshots: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     perfs = [s.get("perf") for s in snaps if s.get("perf")]
     if perfs:
         out["perf"] = merge_ledger_snapshots(perfs)
+    tens = [s.get("tenants") for s in snaps if s.get("tenants")]
+    if tens:
+        # fleet per-tenant usage: counters sum across replicas (the cost
+        # ledger differences the merged cumulative view per interval)
+        tenants: Dict[str, Dict[str, float]] = {}
+        for t in tens:
+            for tenant, counters in t.items():
+                agg = tenants.setdefault(tenant, {})
+                for k, v in counters.items():
+                    agg[k] = agg.get(k, 0) + v
+        out["tenants"] = tenants
     migs = [s.get("migrations") for s in snaps if s.get("migrations")]
     if migs:
         keys = sorted(set().union(*migs))

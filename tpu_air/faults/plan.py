@@ -34,7 +34,8 @@ site                  actions
                       of warning before the chips are reclaimed)
 ``prefill.worker``    ``slow`` (gray failure), ``kill`` (os._exit)
 ``kv.transfer``       ``delay``
-``proxy.request``     ``delay``
+``proxy.request``     ``delay``, ``kill`` (crash a serving replica of
+                      the matched route at admission time)
 ``proxy.poll``        ``delay``, ``kill`` (crash the pinned replica)
 ``train.report``      ``delay``, ``kill`` (os._exit mid-run)
 ``weights.publish``   ``kill`` (torn publish: shards land, the manifest

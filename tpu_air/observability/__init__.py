@@ -12,8 +12,10 @@ from .profiler import profile_trace, step_timer
 from . import perf
 from . import postmortem
 from . import slo
+from . import timeseries
 from . import tracing
 from . import trace_export
+from . import watch
 
 __all__ = [
     "perf",
@@ -24,6 +26,8 @@ __all__ = [
     "start_dashboard",
     "step_timer",
     "stop_dashboard",
+    "timeseries",
     "trace_export",
     "tracing",
+    "watch",
 ]

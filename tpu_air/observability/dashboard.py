@@ -11,9 +11,21 @@ Install_locally.md:64-67):
   /api/traces/export  chrome://tracing-loadable JSON (docs/OBSERVABILITY.md)
   /api/slo          airscope SLO burn-rate state (observability/slo.py),
                     evaluated against the live engine gauges on each GET
+  /api/tenants      airwatch per-tenant cost ledger (observability/watch.py):
+                    tokens, chip-seconds, KV-page-seconds, sheds, and the
+                    chip_seconds_per_1k_tokens headline
+  /api/watch        airwatch state: scrape/anomaly counters, recent
+                    watch.anomaly events (with trace exemplars), detector
+                    baselines, time-series store tiers
   /api/version      framework version
   /metrics          prometheus text exposition (OpenMetrics-style HELP/TYPE
                     headers; engine TTFT histograms carry trace exemplars)
+
+When airwatch is installed, ``/api/engines`` and ``/metrics`` read replica
+snapshots from its scrape cache instead of re-scraping per GET: snapshots
+older than one scrape interval carry a ``stale_s`` age-mark and snapshots
+older than the scrape TTL are dropped, so a killed replica's gauges leave
+the fleet view instead of freezing at their last values.
 """
 
 from __future__ import annotations
@@ -100,20 +112,36 @@ def engine_stats() -> Dict[str, Any]:
     """Per-engine gauge snapshots (the /api/engines payload): driver-local
     engines (bench/test harness, driver-embedded) merged with serve-replica
     engines scraped over the deployment handles' ``engine_stats`` RPC
-    (replica keys: ``deployment/replica-idx/engine-name``)."""
+    (replica keys: ``deployment/replica-idx/engine-name``).
+
+    With airwatch installed AND scraping, the replica side comes from the
+    scraper's TTL-governed cache (see module doc) — stale snapshots age out
+    instead of freezing, and a dashboard GET stops costing a fleet scrape."""
     out: Dict[str, Any] = {}
+    cache = None
+    try:
+        from . import watch as watch_mod
+
+        w = watch_mod.current()
+        if w is not None and w.scrapes:
+            cache = w.cached_engine_stats()
+    except Exception:  # noqa: BLE001 — the cache is an optimization, never a 500
+        cache = None
+    if cache is not None:
+        out.update(cache)
     try:
         from tpu_air.engine.metrics import snapshot_all
     except Exception:  # noqa: BLE001 — engine package optional (no jax)
         pass
     else:
-        out.update(snapshot_all())
-    try:
-        from tpu_air.serve.proxy import replica_engine_stats
-    except Exception:  # noqa: BLE001 — serve package optional
-        pass
-    else:
-        out.update(replica_engine_stats())
+        out.update(snapshot_all())  # driver-local: always live, never stale
+    if cache is None:
+        try:
+            from tpu_air.serve.proxy import replica_engine_stats
+        except Exception:  # noqa: BLE001 — serve package optional
+            pass
+        else:
+            out.update(replica_engine_stats())
     return out
 
 
@@ -152,6 +180,21 @@ def trace_payload(query: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def slo_source() -> Dict[str, Any]:
+    """Snapshot source for the default SLO monitor: the engine gauges plus
+    a ``serve-recovery`` pseudo-snapshot carrying the serve plane's
+    self-healing counters (journal, preemption watcher) so the recovery
+    SLOs — preemption-recovery, migration-fallbacks, journal-evicted-live —
+    are burn-rate-monitorable like any latency objective.  Route prefixes
+    always start with ``/`` and engine names never contain one, so the
+    bare key cannot collide with a real snapshot."""
+    out = dict(engine_stats())
+    recovery = (serve_stats() or {}).get("recovery")
+    if recovery:
+        out["serve-recovery"] = recovery
+    return out
+
+
 def slo_payload() -> Dict[str, Any]:
     """The /api/slo payload: every registered SLO's multi-window burn-rate
     state, freshly evaluated against the live engine gauges.  A scrape IS a
@@ -159,9 +202,31 @@ def slo_payload() -> Dict[str, Any]:
     history, so the windows fill at the polling cadence."""
     from . import slo as slo_mod
 
-    mon = slo_mod.ensure_default(engine_stats)
+    mon = slo_mod.ensure_default(slo_source)
     mon.observe()
     return {"slos": mon.state(), "burning": list(mon.burning())}
+
+
+def tenants_payload() -> Dict[str, Any]:
+    """The /api/tenants payload: airwatch's per-tenant cost ledger, or a
+    bare ``{"enabled": false}`` when airwatch isn't installed."""
+    from . import watch as watch_mod
+
+    w = watch_mod.current()
+    if w is None:
+        return {"enabled": False, "tenants": {}}
+    return {"enabled": True, **w.ledger.snapshot()}
+
+
+def watch_payload() -> Dict[str, Any]:
+    """The /api/watch payload: scrape/anomaly counters, recent events,
+    detector baselines and store stats (observability/watch.py)."""
+    from . import watch as watch_mod
+
+    w = watch_mod.current()
+    if w is None:
+        return {"enabled": False}
+    return w.payload()
 
 
 # every non-engine family /metrics can emit, with its exposition type and
@@ -190,13 +255,72 @@ _SERVE_FAMILIES = [
     ("tpu_air_serve_scale_ups", "counter", "Autoscaler scale-up actions, by route."),
     ("tpu_air_serve_scale_downs", "counter", "Autoscaler scale-down actions, by route."),
 ]
+# serve-plane self-healing counters (PR-15 recovery gauges), exported so the
+# recovery SLOs' raw inputs are scrapeable next to their burn rates
+_RECOVERY_FAMILIES = [
+    ("tpu_air_recovery_journal_size", "gauge",
+     "Replayable streams currently journaled by the serve proxy."),
+    ("tpu_air_recovery_replays", "counter",
+     "Streams replayed onto a survivor replica after their pin died."),
+    ("tpu_air_recovery_replay_failures", "counter",
+     "Stream replays that failed terminally."),
+    ("tpu_air_recovery_journal_evicted_live", "counter",
+     "Live (undelivered) streams evicted from a full journal."),
+    ("tpu_air_recovery_preemptions", "counter",
+     "Lease-revocation notices orchestrated by the preemption watcher."),
+    ("tpu_air_recovery_migrations", "counter",
+     "Streams live-migrated off a preempted replica."),
+    ("tpu_air_recovery_migrated_pages", "counter",
+     "KV pages moved by live migration."),
+    ("tpu_air_recovery_migration_fallbacks", "counter",
+     "Preemptions that fell back to journal replay instead of migration."),
+    ("tpu_air_recovery_preemption_recovery_ms", "gauge",
+     "Worst preemption orchestration wall time, notice to out-of-rotation."),
+]
+# airwatch per-tenant cost ledger (observability/watch.py), by tenant
+_TENANT_FAMILIES = [
+    ("tpu_air_tenant_tokens_prefilled", "counter",
+     "Prompt tokens prefilled, attributed by tenant (adapter_id)."),
+    ("tpu_air_tenant_tokens_decoded", "counter",
+     "Tokens decoded, attributed by tenant."),
+    ("tpu_air_tenant_requests_completed", "counter",
+     "Requests retired, by tenant."),
+    ("tpu_air_tenant_chip_seconds", "counter",
+     "Busy chip-seconds attributed to the tenant by token share."),
+    ("tpu_air_tenant_kv_page_seconds", "counter",
+     "KV-page-seconds of cache residency, by tenant."),
+    ("tpu_air_tenant_migrated_pages", "counter",
+     "KV pages live-migrated for the tenant's streams."),
+    ("tpu_air_tenant_sheds", "counter",
+     "Requests shed at admission, by tenant."),
+    ("tpu_air_tenant_quota_rejected", "counter",
+     "Requests rejected by tenant quota, by tenant."),
+    ("tpu_air_tenant_token_share", "gauge",
+     "Tenant's share of all attributed tokens."),
+    ("tpu_air_tenant_chip_seconds_per_1k_tokens", "gauge",
+     "Attributed chip-seconds per 1000 tokens, by tenant."),
+]
+_WATCH_FAMILIES = [
+    ("tpu_air_watch_scrapes", "counter",
+     "Fleet scrape passes completed by the airwatch scraper."),
+    ("tpu_air_watch_anomalies", "counter",
+     "watch.anomaly events emitted by the online detector."),
+    ("tpu_air_watch_samples_recorded", "counter",
+     "Samples folded into the airwatch time-series store."),
+    ("tpu_air_watch_idle_chip_seconds", "counter",
+     "Chip-seconds observed with no tokens to attribute them to."),
+    ("tpu_air_watch_chip_seconds_per_1k_tokens", "gauge",
+     "Fleet headline: attributed chip-seconds per 1000 tokens."),
+]
 
 
 def _prometheus_text() -> str:
     from tpu_air.utils.metrics import ExpositionBuilder, sanitize_metric_name
 
     b = ExpositionBuilder()
-    for fam, mtype, help_text in _CLUSTER_FAMILIES + _SERVE_FAMILIES:
+    for fam, mtype, help_text in (_CLUSTER_FAMILIES + _SERVE_FAMILIES
+                                  + _RECOVERY_FAMILIES + _TENANT_FAMILIES
+                                  + _WATCH_FAMILIES):
         b.declare(fam, mtype, help_text)
     snap = snapshot()
     lines: list = []
@@ -232,7 +356,10 @@ def _prometheus_text() -> str:
             lines += prometheus_lines(snapshots)
     # serve-plane control gauges: admission outcomes per class and the
     # autoscaler's position, labelled by route
-    for route, ctl in serve_stats().items():
+    sstats = serve_stats()
+    for route, ctl in sstats.items():
+        if not isinstance(ctl, dict) or "admission" not in ctl:
+            continue  # "recovery"/"weights" pseudo-routes handled below
         adm = ctl.get("admission") or {}
         for outcome in ("admitted", "queued", "shed"):
             for klass, n in (adm.get(outcome) or {}).items():
@@ -250,12 +377,42 @@ def _prometheus_text() -> str:
                      sc.get("scale_ups", 0))
             b.sample("tpu_air_serve_scale_downs", {"route": route},
                      sc.get("scale_downs", 0))
+    # self-healing counters: the recovery SLOs' raw inputs (satellite of
+    # docs/OBSERVABILITY.md "airwatch" — burn rates ride tpu_air_slo_*)
+    recovery = sstats.get("recovery") or {}
+    for fam, _mtype, _help in _RECOVERY_FAMILIES:
+        key = fam[len("tpu_air_recovery_"):]
+        if key in recovery:
+            b.sample(fam, {}, recovery[key])
+    # airwatch: per-tenant cost ledger + the watch plane's own counters
+    try:
+        from . import watch as watch_mod
+
+        w = watch_mod.current()
+    except Exception:  # noqa: BLE001 — /metrics must render without airwatch
+        w = None
+    if w is not None:
+        ledger = w.ledger.snapshot()
+        for tenant, tot in sorted(ledger["tenants"].items()):
+            labels = {"tenant": tenant}
+            for fam, _mtype, _help in _TENANT_FAMILIES:
+                key = fam[len("tpu_air_tenant_"):]
+                if key in tot:
+                    b.sample(fam, labels, tot[key])
+        b.sample("tpu_air_watch_scrapes", {}, w.scrapes)
+        b.sample("tpu_air_watch_anomalies", {}, w.anomalies)
+        b.sample("tpu_air_watch_samples_recorded", {},
+                 w.store.stats()["samples_recorded"])
+        b.sample("tpu_air_watch_idle_chip_seconds", {},
+                 ledger["idle_chip_seconds"])
+        b.sample("tpu_air_watch_chip_seconds_per_1k_tokens", {},
+                 ledger["headline"]["chip_seconds_per_1k_tokens"])
     # SLO burn-rate families (the monitor is its own exposition source so
     # the /api/slo JSON and the prometheus lines can never disagree); a
     # /metrics scrape doubles as a burn-rate sample, same as /api/slo
     from . import slo as slo_mod
 
-    mon = slo_mod.ensure_default(engine_stats)
+    mon = slo_mod.ensure_default(slo_source)
     mon.observe()
     slo_lines = mon.prometheus_lines()
     out = b.lines() + lines + slo_lines
@@ -271,6 +428,8 @@ _INDEX_HTML = """<!doctype html><html><head><title>tpu_air dashboard</title></he
 <a href="/api/traces">/api/traces</a> ·
 <a href="/api/traces/export">/api/traces/export</a> ·
 <a href="/api/slo">/api/slo</a> ·
+<a href="/api/tenants">/api/tenants</a> ·
+<a href="/api/watch">/api/watch</a> ·
 <a href="/api/version">/api/version</a> ·
 <a href="/metrics">/metrics</a></p>
 <pre id="s"></pre>
@@ -325,6 +484,12 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             elif path == "/api/slo":
                 self._send(200, json.dumps(slo_payload()).encode(),
+                           "application/json")
+            elif path == "/api/tenants":
+                self._send(200, json.dumps(tenants_payload()).encode(),
+                           "application/json")
+            elif path == "/api/watch":
+                self._send(200, json.dumps(watch_payload()).encode(),
                            "application/json")
             elif path == "/api/version":
                 import tpu_air

@@ -44,12 +44,27 @@ DEFAULT_WINDOWS: Tuple[Tuple[float, float], ...] = (
 
 @dataclass(frozen=True)
 class SLO:
-    """One objective over one engine-snapshot distribution.
+    """One objective over one engine-snapshot metric.
 
-    * ``metric`` — dotted path into an engine snapshot ending at a
-      distribution dict with ``buckets`` (``"ttft_s"``,
-      ``"priority.interactive.ttft_s"``, ``"step_latency_s"``, ...).
-    * ``threshold_s`` — a sample at or under this is a good event.
+    * ``metric`` — dotted path into an engine snapshot.  What it must
+      resolve to depends on ``kind``.
+    * ``kind`` — how samples become (good, total) events:
+
+      - ``"histogram"`` (default) — the path ends at a distribution dict
+        with ``buckets``; every recorded sample is an event, good when at
+        or under ``threshold_s``.
+      - ``"counter"`` — the path ends at a cumulative NUMBER whose every
+        increment is a BAD event (``migration_fallbacks``,
+        ``journal_evicted_live`` — gauges that must not move).  Any
+        in-window movement spends budget at rate 1.0, so the SLO burns
+        exactly while the counter is moving; ``threshold_s`` is unused.
+      - ``"gauge"`` — the path ends at a NUMBER sampled once per observe;
+        each observation is one event, good when the value is at or
+        under ``threshold_s`` IN THE METRIC'S OWN UNITS (e.g.
+        ``preemption_recovery_ms`` against a millisecond threshold).
+
+    * ``threshold_s`` — good-event cutoff (seconds for histogram paths,
+      the metric's units for gauges).
     * ``objective`` — target good fraction (0.99 → 1% error budget).
     * ``windows`` — ``(window_s, max_burn_rate)`` pairs; ALL must exceed
       for the SLO to report burning.
@@ -60,6 +75,7 @@ class SLO:
     threshold_s: float
     objective: float = 0.99
     windows: Tuple[Tuple[float, float], ...] = DEFAULT_WINDOWS
+    kind: str = "histogram"
 
     def __post_init__(self):
         if not 0.0 < self.objective < 1.0:
@@ -68,6 +84,8 @@ class SLO:
             raise ValueError("threshold_s must be positive")
         if not self.windows:
             raise ValueError("at least one (window_s, max_burn) pair required")
+        if self.kind not in ("histogram", "counter", "gauge"):
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
 
 
 def count_le(buckets: Dict[str, Any], threshold: float) -> float:
@@ -94,6 +112,19 @@ def _dig(snapshot: Dict[str, Any], path: str) -> Optional[Dict[str, Any]]:
             return None
         cur = cur.get(part)
     return cur if isinstance(cur, dict) else None
+
+
+def _dig_scalar(snapshot: Dict[str, Any], path: str) -> Optional[float]:
+    """Like :func:`_dig` but the path must end at a number (counter and
+    gauge SLO kinds)."""
+    cur: Any = snapshot
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
 
 
 @dataclass
@@ -124,6 +155,10 @@ class SLOMonitor:
         self._lock = threading.Lock()
         self._history: Dict[str, _History] = {s.name: _History()
                                               for s in slos}
+        # gauge-kind SLOs build their own cumulative (good, total) pairs —
+        # one event per observe — since the snapshot only carries the
+        # instantaneous value
+        self._gauge_acc: Dict[str, List[float]] = {}
 
     # -- sampling ------------------------------------------------------------
     def observe(self, snapshots: Optional[Dict[str, Any]] = None) -> None:
@@ -139,7 +174,29 @@ class SLOMonitor:
                 return
         ts = self._now()
         totals: Dict[str, Tuple[float, float]] = {}
+        gauge_raw: Dict[str, Optional[float]] = {}
         for slo in self.slos:
+            if slo.kind == "counter":
+                # every increment of the summed cumulative counter is a
+                # bad event: good stays 0, total tracks the counter, so a
+                # moving counter burns at rate 1.0 and a still one at 0
+                total = 0.0
+                for snap in snapshots.values():
+                    v = _dig_scalar(snap or {}, slo.metric)
+                    if v is not None:
+                        total += v
+                totals[slo.name] = (0.0, total)
+                continue
+            if slo.kind == "gauge":
+                # worst instantaneous value across snapshots this observe;
+                # turned into one cumulative event under the lock below
+                worst: Optional[float] = None
+                for snap in snapshots.values():
+                    v = _dig_scalar(snap or {}, slo.metric)
+                    if v is not None:
+                        worst = v if worst is None else max(worst, v)
+                gauge_raw[slo.name] = worst
+                continue
             good = total = 0.0
             for snap in snapshots.values():
                 d = _dig(snap or {}, slo.metric)
@@ -154,7 +211,17 @@ class SLOMonitor:
         with self._lock:
             for slo in self.slos:
                 hist = self._history[slo.name]
-                good, total = totals[slo.name]
+                if slo.kind == "gauge":
+                    raw = gauge_raw.get(slo.name)
+                    if raw is None:
+                        continue  # metric absent — no event this observe
+                    acc = self._gauge_acc.setdefault(slo.name, [0.0, 0.0])
+                    acc[1] += 1.0
+                    if raw <= slo.threshold_s:
+                        acc[0] += 1.0
+                    good, total = acc[0], acc[1]
+                else:
+                    good, total = totals[slo.name]
                 # cumulative counters only move forward; an engine restart
                 # (counts drop) resets this SLO's history
                 if hist.points and total < hist.points[-1][2]:
@@ -261,12 +328,23 @@ class SLOMonitor:
 
 def default_slos() -> List[SLO]:
     """The serve plane's stock objectives: interactive TTFT under 1s at
-    99.9%, any-class TTFT under 5s at 99%."""
+    99.9%, any-class TTFT under 5s at 99%, plus the PR-15 recovery gauges
+    (exported by the supervisor into the ``serve-recovery``
+    pseudo-snapshot the dashboard source injects): worst preemption
+    recovery under 2s, and the two must-not-move counters — migration
+    fallbacks and live journal evictions — whose every increment is an
+    error event."""
     return [
         SLO(name="interactive-ttft", threshold_s=1.0, objective=0.999,
             metric="priority.interactive.ttft_s"),
         SLO(name="ttft", threshold_s=5.0, objective=0.99,
             metric="ttft_s"),
+        SLO(name="preemption-recovery", threshold_s=2000.0, objective=0.99,
+            metric="preemption_recovery_ms", kind="gauge"),
+        SLO(name="migration-fallbacks", threshold_s=1.0, objective=0.999,
+            metric="migration_fallbacks", kind="counter"),
+        SLO(name="journal-evicted-live", threshold_s=1.0, objective=0.999,
+            metric="journal_evicted_live", kind="counter"),
     ]
 
 

@@ -180,6 +180,21 @@ class AdmissionController:
         # tenant → currently in-flight request count (admitted minus
         # released); only metered tenants appear
         self._tenant_inflight: Dict[str, int] = {}
+        # per-tenant admission outcomes keyed by adapter_id ("default" for
+        # the base model) — the airwatch cost ledger's shed/quota feed;
+        # EVERY tenant appears here, metered or not
+        self.tenants: Dict[str, Dict[str, int]] = {}
+
+    def _tenant_outcome(self, adapter_id: Optional[str],
+                        outcome: str) -> None:
+        """Count one admission outcome against a tenant (``self._lock``
+        must be held)."""
+        key = adapter_id if adapter_id else "default"
+        d = self.tenants.get(key)
+        if d is None:
+            d = {"admitted": 0, "queued": 0, "shed": 0, "quota_shed": 0}
+            self.tenants[key] = d
+        d[outcome] += 1
 
     # -- gauges ---------------------------------------------------------------
     def gauges(self, force: bool = False) -> Dict[str, Any]:
@@ -261,6 +276,7 @@ class AdmissionController:
             held = self._tenant_inflight.get(adapter_id, 0)
             if held >= cap:
                 self.quota_shed[priority] += 1
+                self._tenant_outcome(adapter_id, "quota_shed")
                 raise QuotaExceededError(
                     f"tenant {adapter_id!r} is at its queue share "
                     f"({held}/{cap} in flight)",
@@ -297,11 +313,13 @@ class AdmissionController:
             if decision == "admit":
                 with self._lock:
                     self.admitted[priority] += 1
+                    self._tenant_outcome(adapter_id, "admitted")
                 return
             p = self.policy
             if decision == "queue":
                 with self._lock:
                     self.queued[priority] += 1
+                    self._tenant_outcome(adapter_id, "queued")
                 deadline = time.monotonic() + float(
                     p.queue_timeout_s.get(priority, 0.0))
                 while time.monotonic() < deadline:
@@ -310,11 +328,13 @@ class AdmissionController:
                     if decision == "admit":
                         with self._lock:
                             self.admitted[priority] += 1
+                            self._tenant_outcome(adapter_id, "admitted")
                         return
                     if decision == "shed":
                         break
             with self._lock:
                 self.shed[priority] += 1
+                self._tenant_outcome(adapter_id, "shed")
             raise AdmissionShedError(
                 f"{priority}-class shed at the proxy "
                 f"(queue depth/replica past policy thresholds)",
@@ -345,5 +365,6 @@ class AdmissionController:
                 "shed": dict(self.shed),
                 "quota_shed": dict(self.quota_shed),
                 "tenant_inflight": dict(self._tenant_inflight),
+                "tenants": {t: dict(d) for t, d in self.tenants.items()},
                 "gauges": dict(self._gauges),
             }

@@ -3,7 +3,7 @@
 The :class:`Autoscaler` is a DRIVER-SIDE control loop (a daemon thread in
 the proxy's process, NOT an actor — nothing here blocks a worker message
 loop) that scales one deployment between ``min_replicas`` and
-``max_replicas`` on two signals from the live engine gauges:
+``max_replicas`` on signals from the live engine gauges:
 
 * **queue pressure** — mean engine admission-queue depth per live replica
   at or above ``scale_up_queue_depth`` means arrivals outrun service:
@@ -20,6 +20,12 @@ loop) that scales one deployment between ``min_replicas`` and
   ``slo_source`` is injectable like ``gauge_source``; by default the
   process-wide installed monitor (``observability.slo.install``) is
   consulted, so wiring a monitor up is enough.
+* **anomaly detection** — when airwatch (observability/watch.py) is
+  installed, a recent ``watch.anomaly`` on any fleet metric is a third
+  scale-up signal of equal rank: the detector catches step changes
+  (a replica death's throughput cliff, a queue-depth spike) one scrape
+  after they happen, before a burn-rate window can confirm them.
+  ``anomaly_source`` is injectable the same way; off ⇒ one global read.
 
 Scale-DOWN is deliberately timid: only after ``scale_down_idle_ticks``
 CONSECUTIVE ticks with empty queues and zero slot occupancy, and never
@@ -54,6 +60,17 @@ def _installed_monitor_burning() -> Tuple[str, ...]:
     return tuple(mon.burning())
 
 
+def _installed_watch_anomalies() -> Tuple[str, ...]:
+    """Default ``anomaly_source``: metrics the installed airwatch detector
+    flagged inside its hold window; empty when airwatch is off (the
+    zero-cost-off path is one module-global read)."""
+    from tpu_air.observability import watch as _watch
+
+    if not _watch.enabled():
+        return ()
+    return tuple(_watch.anomalous())
+
+
 @dataclass(frozen=True)
 class AutoscalerConfig:
     """Dials for one deployment's autoscaler.
@@ -83,7 +100,9 @@ class Autoscaler:
 
     def __init__(self, handle, config: Optional[AutoscalerConfig] = None, *,
                  gauge_source: Optional[Callable[[], Dict[str, Any]]] = None,
-                 slo_source: Optional[Callable[[], Iterable[str]]] = None):
+                 slo_source: Optional[Callable[[], Iterable[str]]] = None,
+                 anomaly_source: Optional[Callable[[],
+                                                   Iterable[str]]] = None):
         self._handle = handle
         self.config = config or AutoscalerConfig()
         if self.config.min_replicas < 1:
@@ -94,6 +113,10 @@ class Autoscaler:
         # returns the names of SLOs currently burning (scale-up signal);
         # default reads whatever monitor the app installed process-wide
         self._slo_source = slo_source or _installed_monitor_burning
+        # third scale signal: metrics the airwatch anomaly detector
+        # flagged recently (observability/watch.py) — a detected step
+        # change in fleet behavior ranks with queue depth and SLO burn
+        self._anomaly_source = anomaly_source or _installed_watch_anomalies
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # decision state below is written by the tick thread and read by
@@ -107,16 +130,20 @@ class Autoscaler:
         self.preemption_scale_ups = 0
         self.last_decision = "hold"
         self.last_burning: tuple = ()
+        self.last_anomalies: tuple = ()
 
     # -- pure policy ----------------------------------------------------------
     def decide(self, snapshots: Dict[str, Dict[str, Any]],
-               replicas: int, burning: Iterable[str] = ()) -> str:
+               replicas: int, burning: Iterable[str] = (),
+               anomalies: Iterable[str] = ()) -> str:
         """``"up"`` / ``"down"`` / ``"hold"`` for one tick's gauges.  Pure
         (no side effects, no cooldown) — the unit-testable core.
 
         ``burning`` names SLOs whose error budget is burning on every
-        evaluation window (observability/slo.py); any entry is a scale-up
-        signal of equal rank with queue depth and the p99 budget.
+        evaluation window (observability/slo.py); ``anomalies`` names
+        metrics the airwatch detector flagged (observability/watch.py).
+        Any entry in either is a scale-up signal of equal rank with queue
+        depth and the p99 budget.
 
         The idle streak that gates scale-down is tracked by :meth:`tick`;
         this method only answers whether THIS tick looks idle (``"down"``
@@ -132,6 +159,8 @@ class Autoscaler:
             if depth / max(replicas, 1) >= cfg.scale_up_queue_depth:
                 return "up"
             if any(True for _ in burning):
+                return "up"
+            if any(True for _ in anomalies):
                 return "up"
             if cfg.ttft_budget_s is not None:
                 p99 = self._interactive_p99(snapshots)
@@ -169,7 +198,11 @@ class Autoscaler:
             burning = tuple(self._slo_source() or ())
         except Exception:  # noqa: BLE001 — a broken SLO source must not kill the loop
             burning = ()
-        decision = self.decide(snapshots, replicas, burning)
+        try:
+            anomalies = tuple(self._anomaly_source() or ())
+        except Exception:  # noqa: BLE001 — a broken detector must not kill the loop
+            anomalies = ()
+        decision = self.decide(snapshots, replicas, burning, anomalies)
         # the idle streak: only an unbroken run of idle ticks earns a
         # scale-down; any non-idle tick resets it
         with self._lock:
@@ -181,6 +214,7 @@ class Autoscaler:
                 self._idle_ticks = 0
             self.last_decision = decision
             self.last_burning = burning
+            self.last_anomalies = anomalies
             if decision == "hold":
                 return "hold"
             if monotonic() - self._last_action_at < cfg.cooldown_s:
@@ -257,4 +291,5 @@ class Autoscaler:
                 "idle_ticks": self._idle_ticks,
                 "last_decision": self.last_decision,
                 "burning_slos": list(self.last_burning),
+                "anomalies": list(self.last_anomalies),
             }

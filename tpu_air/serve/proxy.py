@@ -234,7 +234,18 @@ class _Handler(BaseHTTPRequestHandler):
                             get_runtime().crash_actor(pin)
                 else:
                     if _faults.enabled():
-                        _faults.perturb("proxy.request", key=prefix)
+                        # deterministic chaos: delay this request, or crash
+                        # a serving replica at admission time — the fleet
+                        # loses capacity exactly when load arrives, the
+                        # step change airwatch's detector must catch
+                        spec = _faults.perturb("proxy.request", key=prefix)
+                        if spec is not None and spec.action == "kill":
+                            with handle._lock:
+                                victims = [r._actor_id
+                                           for r in handle._replicas]
+                            if victims:
+                                from tpu_air.core.runtime import get_runtime
+                                get_runtime().crash_actor(victims[0])
                     dirty = False
                     controller = _state.admission.get(prefix)
                     if controller is not None:
@@ -477,6 +488,12 @@ def run(
         # Redeploy on an existing route: retire the previous deployment's
         # replicas so their actor processes and chip leases are released.
         _retire(old)
+    # airwatch (observability/watch.py): an installed watch gets its fleet
+    # scraper thread once replicas exist to scrape; off ⇒ one global read
+    from tpu_air.observability import watch as _watch
+
+    if _watch.enabled():
+        _watch.current().start_scraper()
     return handle
 
 
@@ -512,6 +529,11 @@ def rollout(route_prefix: str = "/", timeout: float = 120.0) -> int:
 
 def shutdown() -> None:
     """Stop the proxy, the control loops, and every replica actor."""
+    # the fleet scraper would only see dead replicas from here on
+    from tpu_air.observability import watch as _watch
+
+    if _watch.enabled():
+        _watch.current().stop_scraper()
     with _state.lock:
         for watcher in _state.watchers.values():
             watcher.stop()
