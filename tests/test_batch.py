@@ -1,0 +1,411 @@
+"""airbatch: the elastic offline batch-inference lane (tpu_air/batch).
+
+Layers under test:
+  * shard_plan / ShardedReader — deterministic seeded assignment, global
+    row indices partition the dataset, a cursor resume yields the exact
+    suffix of the original stream (the seqio contract);
+  * BatchJob checkpoint machinery (engine-free via ``row_fn``) — full
+    epoch, chunk objects partition the row space, a chaos ``batch.runner``
+    kill at the chunk-commit boundary resumes with ZERO dropped and ZERO
+    duplicated rows, fingerprint mismatches are refused;
+  * AdmissionPolicy.token_budgets — tail classes clamp UNSET asks too;
+  * the serve lane end-to-end — rows stream through the route's real
+    admission controller at best_effort, outputs token-identical to
+    offline greedy, work billed to the ``batch:<job_id>`` tenant on both
+    the admission and engine sides, progress on ``/-/stats`` → batch;
+  * elastic chip borrowing — an idle route's capacity is soaked via
+    scale_up and handed back through the preemption drain (watcher counts
+    ``borrow_returns``, no autoscaler backfill);
+  * chaos (``-m chaos``): a seeded plan kills the job driver mid-epoch
+    through serve; the rerun resumes from journaled cursors and the union
+    of output rows equals the input set exactly.
+"""
+
+import collections
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpu_air
+import tpu_air.data as tad
+from tpu_air import faults
+from tpu_air.batch import (
+    BatchJob,
+    BatchJobConfig,
+    BatchJobKilled,
+    ShardedReader,
+    jobs_stats,
+    shard_plan,
+)
+from tpu_air.core.runtime import get_runtime
+from tpu_air.engine import EngineConfig
+from tpu_air.faults import FaultPlan, FaultSpec
+from tpu_air.models.lm import CausalLM, LMConfig
+from tpu_air.models.lm.generate import generate as lm_generate
+
+PORT = 8163
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = LMConfig.tiny()
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+@pytest.fixture
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _prompts(seed, n, lo=3, hi=12, vocab=384):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(1, vocab, size=rng.randint(lo, hi))))
+            for _ in range(n)]
+
+
+def _offline(model, params, prompt, max_new):
+    return np.asarray(lm_generate(
+        model, params, [prompt], max_new_tokens=max_new,
+        eos_token_id=None))[0].tolist()
+
+
+def _prompt_dataset(seed, n, parallelism=4):
+    return tad.from_items([{"prompt": p} for p in _prompts(seed, n)],
+                          parallelism=parallelism)
+
+
+def _chunk_occurrences(job):
+    """Count every global row index across the job's committed chunk
+    objects — the raw exactly-once evidence (results() would dedup)."""
+    store = get_runtime().store
+    counts = collections.Counter()
+    for s in range(job.cfg.num_shards):
+        for c in range(10000):
+            cid = job._chunk_id(s, c)
+            if not store.contains(cid):
+                break
+            counts.update(int(k) for k in store.get(cid)["rows"])
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# sharded readers
+# ---------------------------------------------------------------------------
+
+
+def test_shard_plan_deterministic_covers_and_balances():
+    counts = [10, 5, 7, 3, 12, 1, 9, 4]
+    a = shard_plan(counts, 3, seed=7)
+    assert a == shard_plan(counts, 3, seed=7)
+    assert a != shard_plan(counts, 3, seed=8)  # the seed actually shuffles
+    flat = [b for s in a for b in s]
+    assert sorted(flat) == list(range(len(counts)))  # partition, no dup
+    loads = [sum(counts[b] for b in s) for s in a]
+    # greedy least-loaded: no shard exceeds the fair share by more than
+    # one largest block
+    assert max(loads) - min(loads) <= max(counts)
+    with pytest.raises(ValueError):
+        shard_plan(counts, 0, seed=1)
+
+
+def test_reader_indices_partition_dataset(air):
+    ds = _prompt_dataset(seed=3, n=23, parallelism=5)
+    readers = [ShardedReader(ds, s, 3, seed=11) for s in range(3)]
+    seen = collections.Counter()
+    for r in readers:
+        rows = list(r.rows())
+        assert len(rows) == r.total_rows()
+        seen.update(gi for gi, _ in rows)
+    assert sorted(seen) == list(range(23))
+    assert all(v == 1 for v in seen.values())
+
+
+def test_reader_resume_is_exact_suffix(air):
+    ds = _prompt_dataset(seed=5, n=17, parallelism=4)
+    r = ShardedReader(ds, 0, 2, seed=9)
+    # pandas round-trips the list column as ndarray cells: normalize
+    full = [(gi, list(row["prompt"])) for gi, row in r.rows()]
+    for cut in (0, 1, len(full) // 2, len(full) - 1, len(full)):
+        tail = [(gi, list(row["prompt"])) for gi, row in r.rows(start=cut)]
+        assert tail == full[cut:]  # byte-identical remaining stream
+
+
+# ---------------------------------------------------------------------------
+# BatchJob checkpoint machinery (engine-free via row_fn)
+# ---------------------------------------------------------------------------
+
+
+def test_batchjob_row_fn_full_epoch(air):
+    n = 21
+    ds = _prompt_dataset(seed=13, n=n, parallelism=4)
+    job = BatchJob(ds, job_id="unit-epoch",
+                   config=BatchJobConfig(num_shards=2, seed=4, chunk_rows=4,
+                                         window=3),
+                   row_fn=lambda p: [t + 1 for t in p])
+    stats = job.run()
+    assert stats["state"] == "done"
+    assert stats["rows_total"] == n and stats["rows_done"] == n
+    assert stats["rows_processed"] == n and stats["rows_resumed"] == 0
+    assert stats["checkpoints"] >= 1 and stats["resumes"] == 0
+    results = job.results()
+    prompts = _prompts(13, n)
+    assert sorted(results) == list(range(n))
+    for gi, toks in results.items():
+        assert toks == [t + 1 for t in prompts[gi]]
+    occ = _chunk_occurrences(job)
+    assert sorted(occ) == list(range(n)) and set(occ.values()) == {1}
+    assert jobs_stats()["unit-epoch"]["rows_done"] == n
+
+
+def test_batchjob_kill_then_resume_exactly_once(air, _clean_faults):
+    n = 26
+    ds = _prompt_dataset(seed=17, n=n, parallelism=5)
+    cfg = BatchJobConfig(num_shards=2, seed=6, chunk_rows=4, window=3)
+    calls = []
+    row_fn = lambda p: (calls.append(1), [t * 2 for t in p])[1]  # noqa: E731
+    faults.install(FaultPlan(seed=1, specs=[
+        FaultSpec("batch.runner", "kill", at=3)]))
+    job1 = BatchJob(ds, job_id="unit-resume", config=cfg, row_fn=row_fn)
+    with pytest.raises(BatchJobKilled):
+        job1.run()
+    assert job1.stats()["state"] == "failed"
+    done_before = job1.stats()["rows_done"]
+    assert 0 < done_before < n  # genuinely mid-epoch
+    faults.clear()
+    job2 = BatchJob(ds, job_id="unit-resume", config=cfg, row_fn=row_fn)
+    stats = job2.run()
+    assert stats["state"] == "done" and stats["resumes"] == 1
+    assert stats["rows_resumed"] == done_before  # skipped, not re-run
+    assert stats["rows_processed"] == n - done_before
+    assert len(calls) == n  # across both incarnations: each row ran ONCE
+    occ = _chunk_occurrences(job2)
+    assert sorted(occ) == list(range(n)), "dropped rows"
+    assert set(occ.values()) == {1}, "duplicated rows"
+    prompts = _prompts(17, n)
+    results = job2.results()
+    assert all(results[gi] == [t * 2 for t in prompts[gi]] for gi in results)
+
+
+def test_batchjob_refuses_mismatched_resume(air):
+    ds = _prompt_dataset(seed=19, n=8, parallelism=2)
+    base = dict(num_shards=2, chunk_rows=4, window=2)
+    BatchJob(ds, job_id="unit-fpr", config=BatchJobConfig(seed=1, **base),
+             row_fn=list).run()
+    clash = BatchJob(ds, job_id="unit-fpr",
+                     config=BatchJobConfig(seed=2, **base), row_fn=list)
+    with pytest.raises(ValueError, match="re-shard"):
+        clash.run()
+
+
+def test_batchjob_rejects_interactive_priority(air):
+    ds = _prompt_dataset(seed=19, n=4, parallelism=1)
+    with pytest.raises(ValueError, match="interactive"):
+        BatchJob(ds, config=BatchJobConfig(priority="interactive"))
+
+
+# ---------------------------------------------------------------------------
+# admission: tail classes clamp UNSET asks (satellite of the batch lane)
+# ---------------------------------------------------------------------------
+
+
+def test_token_budgets_clamp_unset_asks_for_tail_classes():
+    from tpu_air.serve.admission import AdmissionPolicy
+
+    p = AdmissionPolicy(token_budgets={"interactive": 256, "batch": 1024,
+                                       "best_effort": 512},
+                        tenant_token_budgets={"t-small": 64})
+    # explicit asks trim as before
+    assert p.clamp_budget("best_effort", 9000) == 512
+    assert p.clamp_budget("interactive", 100) == 100
+    # UNSET asks: interactive stays unset (engine default governs)...
+    assert p.clamp_budget("interactive", None) is None
+    # ...but a best_effort/batch flood that omits the ask must NOT
+    # inherit the engine max — the class budget applies
+    assert p.clamp_budget("best_effort", None) == 512
+    assert p.clamp_budget("batch", None) == 1024
+    # tenant budget composes by MIN and caps unset asks for every class
+    assert p.clamp_budget("interactive", None, "t-small") == 64
+    assert p.clamp_budget("best_effort", None, "t-small") == 64
+    assert p.clamp_budget("best_effort", 9000, "t-small") == 64
+
+
+# ---------------------------------------------------------------------------
+# the serve lane end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_batch_job_streams_through_serve_admission(lm, air):
+    from tpu_air import serve
+    from tpu_air.engine.metrics import merge_snapshots
+    from tpu_air.serve import EngineDeployment
+    from tpu_air.serve.proxy import (replica_engine_stats, route_control,
+                                     serve_control_stats)
+    from tpu_air.train import Checkpoint
+
+    cfg, model, params = lm
+    ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+    n, max_new = 10, 12
+    ds = _prompt_dataset(seed=29, n=n, parallelism=3)
+    try:
+        serve.run(
+            EngineDeployment.options(
+                name="lm-batch", route_prefix="/batchlane", num_replicas=1,
+                num_chips=1,
+            ).bind(ckpt, EngineConfig(num_slots=4, slot_len=64,
+                                      max_new_tokens=max_new, page_len=16)),
+            port=PORT,
+        )
+        job = BatchJob(ds, job_id="serve-epoch", config=BatchJobConfig(
+            route_prefix="/batchlane", max_new_tokens=max_new,
+            num_shards=2, seed=8, chunk_rows=3, window=4))
+        stats = job.run()
+        assert stats["state"] == "done" and stats["rows_done"] == n
+        results = job.results()
+        prompts = _prompts(29, n)
+        assert sorted(results) == list(range(n))
+        for gi, toks in results.items():
+            assert toks == _offline(model, params, prompts[gi], max_new)
+        # one admission path: the route's controller metered every row
+        # under the job's billing tenant...
+        adm = route_control("/batchlane")["admission"]
+        assert adm.tenants["batch:serve-epoch"]["admitted"] == n
+        # ...and the engine billed its tokens to the same tenant label
+        # (the CostLedger's batch-vs-interactive split rides these keys)
+        merged = merge_snapshots(replica_engine_stats())
+        tstats = merged.get("tenants") or {}
+        assert "batch:serve-epoch" in tstats
+        assert tstats["batch:serve-epoch"].get("requests_completed") == n
+        # progress rides the serve control surface (→ /api/batch, metrics)
+        assert serve_control_stats()["batch"]["serve-epoch"]["rows_done"] == n
+    finally:
+        serve.shutdown()
+
+
+def test_batch_borrows_idle_capacity_and_returns_it(lm, air):
+    from tpu_air import serve
+    from tpu_air.serve import EngineDeployment
+    from tpu_air.serve.proxy import route_control, serve_control_stats
+    from tpu_air.train import Checkpoint
+
+    cfg, model, params = lm
+    ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+    n, max_new = 8, 8
+    ds = _prompt_dataset(seed=31, n=n, parallelism=2)
+    try:
+        serve.run(
+            EngineDeployment.options(
+                name="lm-borrow", route_prefix="/borrow", num_replicas=1,
+                num_chips=1,
+            ).bind(ckpt, EngineConfig(num_slots=4, slot_len=64,
+                                      max_new_tokens=max_new, page_len=16)),
+            port=PORT,
+        )
+        handle = route_control("/borrow")["handle"]
+        assert handle.live_replicas() == 1
+        job = BatchJob(ds, job_id="serve-borrow", config=BatchJobConfig(
+            route_prefix="/borrow", max_new_tokens=max_new,
+            num_shards=2, seed=12, chunk_rows=2, window=2,
+            borrow=True, borrow_depth_low=4.0, borrow_depth_high=100.0,
+            borrow_notice_s=10.0))
+        stats = job.run()
+        assert stats["state"] == "done" and stats["rows_done"] == n
+        # the trough was soaked: a replica was borrowed mid-job and handed
+        # back through the preemption drain when the job ended
+        assert stats["borrows"] >= 1
+        assert stats["borrow_returns"] == stats["borrows"]
+        assert stats["borrowed_replicas"] == 0  # nothing stranded
+        # the watcher orchestrates the return on its own poll cadence:
+        # wait for the drain to land, then check the voluntary return was
+        # NOT backfilled — capacity settles back at the deployed size
+        import time as _time
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 30.0:
+            rec = serve_control_stats()["recovery"]
+            if (rec.get("borrow_returns", 0) >= 1
+                    and handle.live_replicas() == 1):
+                break
+            _time.sleep(0.2)
+        rec = serve_control_stats()["recovery"]
+        assert rec.get("borrow_returns", 0) >= 1, rec
+        assert handle.live_replicas() == 1
+        prompts = _prompts(31, n)
+        results = job.results()
+        for gi, toks in results.items():
+            assert toks == _offline(model, params, prompts[gi], max_new)
+    finally:
+        serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: driver killed mid-epoch through serve, rerun resumes lossless
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_batch_driver_kill_mid_epoch_resumes_lossless(lm, air,
+                                                      _clean_faults):
+    """The lane's acceptance gate: a seeded plan kills the batch-job
+    driver at a chunk-commit boundary (chunk durable, checkpoint not —
+    the hardest window).  The rerun resumes from the journaled cursors:
+    the union of output rows equals the input set EXACTLY (zero drops,
+    zero duplicates, counted over the raw chunk objects) and every output
+    is token-identical to offline greedy."""
+    from tpu_air import serve
+    from tpu_air.serve import EngineDeployment
+    from tpu_air.train import Checkpoint
+
+    cfg, model, params = lm
+    ckpt = Checkpoint.from_model(model_config=cfg, params=params)
+    # seed pinned by the workflow matrix (TPU_AIR_FAULT_SEED) so a red CI
+    # run replays locally with the identical schedule
+    seed = int(os.environ.get("TPU_AIR_FAULT_SEED", "7"))
+    rng = np.random.RandomState(seed)
+    n, max_new = 12, 10
+    jcfg = BatchJobConfig(route_prefix="/bchaos", max_new_tokens=max_new,
+                          num_shards=2, seed=seed, chunk_rows=2, window=3)
+    # 12 rows / 2-row chunks = 6 commit boundaries; kill in the middle
+    plan = FaultPlan(seed=seed, specs=[
+        FaultSpec("batch.runner", "kill", at=int(rng.randint(2, 5)))])
+    assert plan.to_json() == FaultPlan.from_json(plan.to_json()).to_json()
+    ds = _prompt_dataset(seed=37, n=n, parallelism=4)
+    job_id = f"chaos-{seed}"
+    try:
+        serve.run(
+            EngineDeployment.options(
+                name="lm-bchaos", route_prefix="/bchaos", num_replicas=1,
+                num_chips=1,
+            ).bind(ckpt, EngineConfig(num_slots=4, slot_len=64,
+                                      max_new_tokens=max_new, page_len=16)),
+            port=PORT,
+        )
+        faults.install(plan)
+        job1 = BatchJob(ds, job_id=job_id, config=jcfg)
+        with pytest.raises(BatchJobKilled):
+            job1.run()
+        faults.clear()
+        done_before = job1.stats()["rows_done"]
+        assert 0 < done_before < n
+        job2 = BatchJob(ds, job_id=job_id, config=jcfg)
+        stats = job2.run()
+        assert stats["state"] == "done" and stats["resumes"] == 1
+        assert stats["rows_resumed"] == done_before
+        assert stats["rows_done"] == n
+        occ = _chunk_occurrences(job2)
+        assert sorted(occ) == list(range(n)), "dropped rows"
+        assert set(occ.values()) == {1}, "duplicated rows"
+        prompts = _prompts(37, n)
+        results = job2.results()
+        assert sorted(results) == list(range(n))
+        for gi, toks in results.items():
+            assert toks == _offline(model, params, prompts[gi], max_new)
+    finally:
+        serve.shutdown()
+        faults.clear()

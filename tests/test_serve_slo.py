@@ -109,14 +109,19 @@ def test_admission_queue_times_out_to_shed():
     assert c.queued["best_effort"] == 1 and c.shed["best_effort"] == 1
 
 
-def test_admission_token_budget_clamps_explicit_asks_only():
+def test_admission_token_budget_clamp_by_class():
     p = AdmissionPolicy(token_budgets={"interactive": 256, "batch": 1024,
                                        "best_effort": 512})
     assert p.clamp_budget("best_effort", 4096) == 512
     assert p.clamp_budget("interactive", 64) == 64
-    # unset stays unset: the engine config's own default governs (it is
-    # sized to the engine's slots; inventing a budget here can exceed them)
-    assert p.clamp_budget("batch", None) is None
+    # an INTERACTIVE unset ask stays unset — the engine config's own
+    # default governs (it is sized to the engine's slots; inventing a
+    # budget here can exceed them).  The TAIL classes get the class
+    # budget applied even to unset asks: a batch flood that omits
+    # max_new_tokens must not default to the engine max
+    assert p.clamp_budget("interactive", None) is None
+    assert p.clamp_budget("batch", None) == 1024
+    assert p.clamp_budget("best_effort", None) == 512
 
 
 # ---------------------------------------------------------------------------

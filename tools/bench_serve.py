@@ -45,6 +45,20 @@ of rescued streams that moved with their KV state (zero re-prefill)
 rather than falling back to journal replay; with a generous notice it
 must be 1.0.
 
+A fifth **batch** phase measures the elastic offline lane
+(tpu_air/batch, docs/SERVING.md "Batch lane"): a ``BatchJob`` epoch
+streams rows through the route at ``best_effort`` while the interactive
+trace runs open-loop — first a trough (base interactive rate; the job
+borrows the idle chip via ``scale_up`` and widens its window), then a
+spike (6x interactive rate, longer streams; depth crosses
+``borrow_depth_high`` and the loan is preempted back through the
+lease-notice drain).  The phase gets a FRESH runtime and watch: the job
+bills the cost ledger as tenant ``batch:<job_id>``, which would dilute
+the main run's pinned ``cost.tenants.default.token_share = 1.0``.
+Headline: ``rows_s_per_chip`` — epoch rows per ledger-accounted engine
+chip-second (attributed + idle), so holding a borrowed chip without
+converting it to rows costs the number.
+
 Reported per phase and class: arrivals, completed, shed (proxy 503s and
 engine-side overload look identical to the client), proxy-side
 queued/shed counter deltas, TTFT p50/p99 both CLIENT-observed (includes
@@ -611,6 +625,100 @@ def main():
                 for name, t in (led.get("tenants") or {}).items()
             },
         }
+
+        # -- batch phase: offline epoch with borrowing, trough + spike ----
+        from tpu_air.batch import BatchJob, BatchJobConfig
+        from tpu_air.data import from_items
+
+        # fresh runtime AND a fresh watch: the job bills the ledger as
+        # tenant batch:<job_id>, which would dilute the pinned
+        # cost.tenants.default.token_share = 1.0 headline above — the
+        # lane gets its own ledger and a clean chip pool
+        serve.shutdown()
+        tpu_air.shutdown()
+        watch_mod.clear()
+        tpu_air.init(num_cpus=4, num_chips=8)
+        batch_watch = watch_mod.install(watch_mod.WatchConfig(
+            interval_s=0.5, seed=args.seed))
+        serve.run(
+            EngineDeployment.options(
+                name="bench-engine", route_prefix="/engine",
+                num_replicas=1, num_chips=1,
+            ).bind(ckpt, engine_cfg),
+            port=PORT,
+            admission_policy=policy,
+        )
+        # warm the replica's prefill buckets across the prompt-length
+        # range — a fresh process recompiles per bucket, and a multi-
+        # second compile stall under the spike reads as interactive shed
+        for wp in (prompts[0], min(prompts, key=len), max(prompts, key=len)):
+            _post("/engine", {"prompt": wp, "priority": "batch",
+                              "max_new_tokens": args.max_new}, timeout=300.0)
+
+        n_rows = max(48, int(round(args.duration * 25)))
+        ds = from_items([{"prompt": prompts[i % len(prompts)]}
+                         for i in range(n_rows)], parallelism=4)
+        # thresholds sized to the tiny engine: the job's own queued rows
+        # sit ~2 deep (window 4, two non-reserved slots), under the
+        # borrow gate in the trough; the spike's longer interactive
+        # streams queue past borrow_depth_high and preempt the loan back
+        job = BatchJob(ds, job_id="bench-epoch", config=BatchJobConfig(
+            route_prefix="/engine", max_new_tokens=args.max_new,
+            priority="best_effort", num_shards=2, seed=args.seed,
+            chunk_rows=8, window=4, borrow=True,
+            borrow_depth_low=2.5, borrow_depth_high=3.0,
+            borrow_notice_s=5.0))
+        job_out = {}
+
+        def _epoch():
+            job_out.update(job.run())
+
+        jth = threading.Thread(target=_epoch, daemon=True)
+        t_batch = time.monotonic()
+        jth.start()
+        result["batch_trough"] = _run_phase(
+            args.interactive_rps, 0.0, args.duration / 2.0,
+            prompts, args.max_new, rng)
+        result["batch_spike"] = _run_phase(
+            args.interactive_rps * 6.0, 0.0, args.duration / 2.0,
+            prompts, max(args.max_new, 32), rng)
+        jth.join(timeout=600.0)
+        batch_wall = round(time.monotonic() - t_batch, 3)
+
+        # one synchronous scrape closes the last attribution interval;
+        # the denominator is TOTAL engine chip-time the lane's ledger saw
+        # (attributed + idle) — the borrowed replica counts only while
+        # the loan is held
+        batch_watch.scrape_once()
+        bled = batch_watch.ledger.snapshot()
+        bhead = bled.get("headline") or {}
+        chip_s = (float(bhead.get("chip_seconds_attributed", 0.0))
+                  + float(bled.get("idle_chip_seconds", 0.0)))
+        if chip_s <= 0.0:
+            chip_s = batch_wall  # ledger empty (scraper raced shutdown)
+        rows_done = int(job_out.get("rows_done") or 0)
+        result["batch"] = {
+            "wall_s_epoch": batch_wall,
+            "chip_seconds": round(chip_s, 3),
+            "job": {k: job_out.get(k) for k in (
+                "state", "rows_total", "rows_done", "rows_per_s",
+                "chunks_done", "checkpoints", "borrows", "borrow_returns",
+                "borrowed_replicas", "shed_retries", "submit_retries")},
+            "cost": {
+                "batch_chip_seconds": round(
+                    float(bhead.get("batch_chip_seconds", 0.0)), 3),
+                "interactive_chip_seconds": round(
+                    float(bhead.get("interactive_chip_seconds", 0.0)), 3),
+                "batch_chip_share": round(
+                    float(bhead.get("batch_chip_share", 0.0)), 4),
+            },
+        }
+        result["rows_s_per_chip"] = round(rows_done / chip_s, 3) \
+            if chip_s else 0.0
+        result["batch_errors_total"] = sum(
+            c["errors"]
+            for ph in ("batch_trough", "batch_spike")
+            for c in result[ph]["classes"].values())
     finally:
         serve.shutdown()
         tpu_air.shutdown()

@@ -1,0 +1,34 @@
+"""airbatch — the elastic offline batch-inference lane (docs/SERVING.md
+"Batch lane").  Public surface:
+
+* :class:`BatchJob` / :class:`BatchJobConfig` — run a resumable epoch of
+  a dataset through a deployed serve route at ``best_effort`` priority.
+* :mod:`tpu_air.batch.reader` — deterministic sharded readers
+  (:func:`shard_plan`, :class:`ShardedReader`, :class:`ShardCursor`).
+* :func:`jobs_stats` — the observability snapshot behind ``/-/stats`` →
+  ``batch``, the dashboard's ``/api/batch``, and ``tpu_air_batch_*``.
+"""
+
+from tpu_air.batch.job import (
+    BatchJob,
+    BatchJobConfig,
+    BatchJobKilled,
+    clear_registry,
+    get_job,
+    jobs_stats,
+    register_job,
+)
+from tpu_air.batch.reader import ShardCursor, ShardedReader, shard_plan
+
+__all__ = [
+    "BatchJob",
+    "BatchJobConfig",
+    "BatchJobKilled",
+    "ShardCursor",
+    "ShardedReader",
+    "shard_plan",
+    "clear_registry",
+    "get_job",
+    "jobs_stats",
+    "register_job",
+]

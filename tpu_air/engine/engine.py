@@ -210,7 +210,8 @@ class InferenceEngine:
                       priority: str = "interactive", *,
                       admit_while_draining: bool = False,
                       deadline_ms: Optional[float] = None,
-                      adapter_id: Optional[str] = None) -> Request:
+                      adapter_id: Optional[str] = None,
+                      tenant: Optional[str] = None) -> Request:
         """Shared validation + Request construction for both submit paths.
 
         ``admit_while_draining`` is the disaggregated-handoff escape hatch:
@@ -263,7 +264,8 @@ class InferenceEngine:
                        priority=priority,
                        deadline_ms=(None if deadline_ms is None
                                     else float(deadline_ms)),
-                       adapter_id=adapter_id)
+                       adapter_id=adapter_id,
+                       tenant=(str(tenant) if tenant else None))
 
     def _enqueue(self, req: Request) -> ResponseStream:
         try:
@@ -279,7 +281,8 @@ class InferenceEngine:
                priority: str = "interactive",
                stream: Optional[ResponseStream] = None,
                deadline_ms: Optional[float] = None,
-               adapter_id: Optional[str] = None) -> ResponseStream:
+               adapter_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> ResponseStream:
         """Queue one prompt; returns its token stream immediately.
 
         ``priority`` is the request's SLO class (``types.PRIORITIES``):
@@ -293,11 +296,15 @@ class InferenceEngine:
         :class:`~tpu_air.faults.retry.DeadlineExceededError` instead of
         occupying a slot it can no longer use.  ``adapter_id`` selects the
         tenant LoRA adapter the request decodes under (None = base model;
-        unknown/unloaded names raise ValueError here)."""
+        unknown/unloaded names raise ValueError here).  ``tenant`` is the
+        pure cost-attribution label (never validated): airwatch bills
+        ``tenant or adapter_id`` — the batch lane stamps
+        ``batch:<job_id>`` so offline rows never fold into "default"."""
         return self._enqueue(self._make_request(prompt, max_new_tokens,
                                                 stream, priority,
                                                 deadline_ms=deadline_ms,
-                                                adapter_id=adapter_id))
+                                                adapter_id=adapter_id,
+                                                tenant=tenant))
 
     def submit_prefilled(self, prompt: Sequence[int], first_token: int,
                          kv_pages: Dict[str, Any],
@@ -459,6 +466,7 @@ class InferenceEngine:
                     "priority": req.priority,
                     "deadline_ms": req.deadline_ms,
                     "adapter_id": req.adapter_id,
+                    "tenant": req.tenant,
                     "pages": pages,
                 })
                 self.metrics.record_migration("out", len(page_ids))
@@ -513,7 +521,8 @@ class InferenceEngine:
                                  payload.get("priority", "interactive"),
                                  admit_while_draining=True,
                                  deadline_ms=payload.get("deadline_ms"),
-                                 adapter_id=payload.get("adapter_id"))
+                                 adapter_id=payload.get("adapter_id"),
+                                 tenant=payload.get("tenant"))
         req.migrated = {"streamed": streamed, "pages": payload["pages"],
                         "client_prompt_len": len(prompt)}
         return self._enqueue(req)
@@ -685,7 +694,8 @@ class InferenceEngine:
         self._pos[slot.index] = p
         self.metrics.record_migration(
             "in", len(page_ids), reprefill_chunks=slot.plan.chunks_left)
-        self.metrics.record_tenant_migrated(req.adapter_id, len(page_ids))
+        self.metrics.record_tenant_migrated(req.tenant or req.adapter_id,
+                                            len(page_ids))
         if slot.budget_left == 0 or (
             self.eos_token_id is not None
             and streamed[-1] == self.eos_token_id
@@ -1063,11 +1073,12 @@ class InferenceEngine:
         self.metrics.record_goodput(
             "useful", slot.pos - len(slot.request.prompt) + 1)
         # per-tenant cost attribution (airwatch ledger feed): bill the
-        # stream's tokens and KV-page residency to its adapter_id tenant.
-        # Residency runs from first token (pages are fully resident once
-        # prefill lands) to retirement; page count mirrors the pool's own
-        # ceil-division for paged engines, the fixed slot reservation for
-        # slab engines.
+        # stream's tokens and KV-page residency to its billing tenant —
+        # the explicit ``tenant`` label when one rides the request (batch
+        # lane), else its adapter_id tenant.  Residency runs from first
+        # token (pages are fully resident once prefill lands) to
+        # retirement; page count mirrors the pool's own ceil-division for
+        # paged engines, the fixed slot reservation for slab engines.
         req = slot.request
         if self.paged:
             n_pages = -(-slot.pos // self.config.page_len)
@@ -1076,7 +1087,7 @@ class InferenceEngine:
         resident_s = max(
             0.0, time.monotonic() - (req.first_token_at or req.submitted_at))
         self.metrics.record_tenant_retire(
-            req.adapter_id,
+            req.tenant or req.adapter_id,
             prefilled=len(req.prompt),
             decoded=slot.pos - len(req.prompt) + 1,
             kv_page_seconds=n_pages * resident_s)

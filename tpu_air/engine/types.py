@@ -272,3 +272,10 @@ class Request:
     # the resolved bank row the slot gathers each step (0 = zero adapter).
     adapter_id: Optional[str] = None
     adapter_row: int = 0
+    # cost-attribution label (airwatch CostLedger): who to BILL this
+    # request's tokens/chip-seconds to when that differs from the LoRA
+    # tenant — the batch lane stamps ``batch:<job_id>`` here so offline
+    # work never folds into the interactive "default" tenant.  Unlike
+    # ``adapter_id`` it is never validated (a pure label, not a bank row);
+    # billing uses ``tenant or adapter_id``.
+    tenant: Optional[str] = None

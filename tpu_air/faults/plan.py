@@ -44,6 +44,9 @@ site                  actions
                       ``delay`` (stall before the manifest write)
 ``weights.swap``      ``delay``, ``error`` (the swap RPC fails on the
                       target replica)
+``batch.runner``      ``delay``, ``kill`` (the batch-job driver dies at
+                      a chunk-commit boundary — BatchJobKilled; a rerun
+                      of the same job_id must resume exactly-once)
 ====================  ==========================================
 
 This module is pure stdlib and imports nothing from ``tpu_air`` — it sits

@@ -17,6 +17,9 @@ Install_locally.md:64-67):
   /api/watch        airwatch state: scrape/anomaly counters, recent
                     watch.anomaly events (with trace exemplars), detector
                     baselines, time-series store tiers
+  /api/batch        airbatch job progress (tpu_air/batch): rows done/total,
+                    rows-per-second, in-flight window, borrowed replicas,
+                    shed retries — one entry per registered BatchJob
   /api/version      framework version
   /metrics          prometheus text exposition (OpenMetrics-style HELP/TYPE
                     headers; engine TTFT histograms carry trace exemplars)
@@ -229,6 +232,17 @@ def watch_payload() -> Dict[str, Any]:
     return w.payload()
 
 
+def batch_payload() -> Dict[str, Any]:
+    """The /api/batch payload: every registered batch job's progress
+    snapshot (tpu_air/batch/job.py ``jobs_stats``)."""
+    try:
+        from tpu_air.batch import jobs_stats
+        jobs = jobs_stats()
+    except Exception:  # noqa: BLE001 — the dashboard must render without the lane
+        jobs = {}
+    return {"jobs": jobs}
+
+
 # every non-engine family /metrics can emit, with its exposition type and
 # HELP text (engine families live in engine/metrics.py next to their data)
 _CLUSTER_FAMILIES = [
@@ -300,6 +314,33 @@ _TENANT_FAMILIES = [
     ("tpu_air_tenant_chip_seconds_per_1k_tokens", "gauge",
      "Attributed chip-seconds per 1000 tokens, by tenant."),
 ]
+# airbatch job progress (tpu_air/batch), labelled by job — the counters
+# are per-incarnation (a resumed driver restarts them; rows_done carries
+# the epoch-level position via rows_resumed)
+_BATCH_FAMILIES = [
+    ("tpu_air_batch_rows_total", "gauge",
+     "Rows in the batch job's dataset epoch."),
+    ("tpu_air_batch_rows_done", "gauge",
+     "Rows committed so far (processed this run + resumed from chunks)."),
+    ("tpu_air_batch_rows_per_s", "gauge",
+     "Rows processed per second by this driver incarnation."),
+    ("tpu_air_batch_inflight", "gauge",
+     "Rows currently in flight through serve admission."),
+    ("tpu_air_batch_window", "gauge",
+     "Current in-flight window (widened while borrowing chips)."),
+    ("tpu_air_batch_borrowed_replicas", "gauge",
+     "Serve replicas currently on loan to the batch job."),
+    ("tpu_air_batch_borrows", "counter",
+     "Replicas borrowed from idle serve capacity, lifetime."),
+    ("tpu_air_batch_borrow_returns", "counter",
+     "Borrowed replicas handed back through the preemption drain."),
+    ("tpu_air_batch_checkpoints", "counter",
+     "Cursor checkpoints journaled to the object store."),
+    ("tpu_air_batch_resumes", "counter",
+     "1 when this incarnation resumed from a checkpoint."),
+    ("tpu_air_batch_shed_retries", "counter",
+     "Admission sheds absorbed by backoff (best_effort yielding)."),
+]
 _WATCH_FAMILIES = [
     ("tpu_air_watch_scrapes", "counter",
      "Fleet scrape passes completed by the airwatch scraper."),
@@ -320,7 +361,7 @@ def _prometheus_text() -> str:
     b = ExpositionBuilder()
     for fam, mtype, help_text in (_CLUSTER_FAMILIES + _SERVE_FAMILIES
                                   + _RECOVERY_FAMILIES + _TENANT_FAMILIES
-                                  + _WATCH_FAMILIES):
+                                  + _BATCH_FAMILIES + _WATCH_FAMILIES):
         b.declare(fam, mtype, help_text)
     snap = snapshot()
     lines: list = []
@@ -384,6 +425,14 @@ def _prometheus_text() -> str:
         key = fam[len("tpu_air_recovery_"):]
         if key in recovery:
             b.sample(fam, {}, recovery[key])
+    # airbatch: per-job progress gauges, same key-strip pattern as the
+    # recovery/tenant families (family name minus prefix == stats key)
+    for job_id, jstats in sorted((batch_payload().get("jobs") or {}).items()):
+        labels = {"job": job_id}
+        for fam, _mtype, _help in _BATCH_FAMILIES:
+            key = fam[len("tpu_air_batch_"):]
+            if key in jstats:
+                b.sample(fam, labels, jstats[key])
     # airwatch: per-tenant cost ledger + the watch plane's own counters
     try:
         from . import watch as watch_mod
@@ -430,6 +479,7 @@ _INDEX_HTML = """<!doctype html><html><head><title>tpu_air dashboard</title></he
 <a href="/api/slo">/api/slo</a> ·
 <a href="/api/tenants">/api/tenants</a> ·
 <a href="/api/watch">/api/watch</a> ·
+<a href="/api/batch">/api/batch</a> ·
 <a href="/api/version">/api/version</a> ·
 <a href="/metrics">/metrics</a></p>
 <pre id="s"></pre>
@@ -490,6 +540,9 @@ class _Handler(BaseHTTPRequestHandler):
                            "application/json")
             elif path == "/api/watch":
                 self._send(200, json.dumps(watch_payload()).encode(),
+                           "application/json")
+            elif path == "/api/batch":
+                self._send(200, json.dumps(batch_payload()).encode(),
                            "application/json")
             elif path == "/api/version":
                 import tpu_air

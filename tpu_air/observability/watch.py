@@ -323,6 +323,13 @@ class CostLedger:
             v["token_share"] = (toks / tokens_total) if tokens_total else 0.0
             v["chip_seconds_per_1k_tokens"] = (
                 1000.0 * v["chip_seconds"] / toks if toks else 0.0)
+        # lane split: the batch runner bills under ``batch:<job_id>``
+        # tenants, so summing over that prefix separates offline soak from
+        # interactive serving — the "was borrowing actually free?" number
+        batch_chip = sum(v["chip_seconds"] for t, v in tenants.items()
+                         if t.startswith("batch:"))
+        batch_tokens = sum(v["tokens_total"] for t, v in tenants.items()
+                           if t.startswith("batch:"))
         return {
             "tenants": tenants,
             "idle_chip_seconds": idle,
@@ -334,6 +341,11 @@ class CostLedger:
                 "chip_seconds_per_1k_tokens": (
                     1000.0 * chip_total / tokens_total if tokens_total
                     else 0.0),
+                "batch_chip_seconds": batch_chip,
+                "interactive_chip_seconds": chip_total - batch_chip,
+                "batch_tokens": batch_tokens,
+                "batch_chip_share": (batch_chip / chip_total
+                                     if chip_total else 0.0),
             },
         }
 

@@ -11,6 +11,18 @@ land in HBM once, the generate fn compiles once) and then maps over Arrow
 blocks pulled from the host object store — the reference's "autoscaling actor
 pool" (Scaling_batch_inference.ipynb:cc-4) becomes a fixed-size pool of
 chip-leasing actors sized by ``min/max_scoring_workers``.
+
+Boundary vs :class:`tpu_air.batch.BatchJob` (the airbatch serve lane):
+this module OWNS its compute — a dedicated actor pool leases its own
+chips, maps whole blocks, and releases everything when ``predict``
+returns; throughput is bounded by the pool and nothing is shared with
+serving.  ``BatchJob`` instead rides an already-deployed serve route at
+``best_effort`` priority: it owns no chips (it borrows idle serve
+capacity and is preempted back), goes through the SAME admission
+controller as interactive traffic, and is checkpoint-resumable
+row-by-row.  Rule of thumb: dedicated offline cluster time → this
+module; trickle millions of rows through a live serving fleet without
+touching its SLO → ``tpu_air.batch``.
 """
 
 from __future__ import annotations

@@ -122,17 +122,24 @@ class AdmissionPolicy:
                      max_new_tokens: Optional[int],
                      adapter_id: Optional[str] = None) -> Optional[int]:
         """The effective decode budget for one request of this class (and
-        tenant).  An UNSET request stays unset — the engine config's own
-        default (sized to its slots) governs; the class budget only trims
-        explicit asks.  A tenant budget composes by MIN with the class
-        budget, and — unlike the class budget — also caps UNSET asks (a
+        tenant).  An INTERACTIVE request with an unset ask stays unset —
+        the engine config's own default (sized to its slots) governs; the
+        class budget only trims explicit asks.  The TAIL classes
+        (``batch``/``best_effort``) get their class budget applied even to
+        UNSET asks: a batch flood that omits ``max_new_tokens`` must not
+        default to the engine max.  A tenant budget composes by MIN with
+        the class budget and also caps unset asks for every class (a
         metered tenant must not inherit the engine default)."""
         cap = self.token_budgets.get(priority)
         tenant_cap = None
         if self.tenant_token_budgets is not None and adapter_id is not None:
             tenant_cap = self.tenant_token_budgets.get(adapter_id)
         if max_new_tokens is None:
-            return int(tenant_cap) if tenant_cap is not None else None
+            caps = [c for c in (tenant_cap,
+                                cap if priority in ("batch", "best_effort")
+                                else None)
+                    if c is not None]
+            return min(int(c) for c in caps) if caps else None
         out = int(max_new_tokens)
         if cap is not None:
             out = min(out, int(cap))
@@ -180,16 +187,18 @@ class AdmissionController:
         # tenant → currently in-flight request count (admitted minus
         # released); only metered tenants appear
         self._tenant_inflight: Dict[str, int] = {}
-        # per-tenant admission outcomes keyed by adapter_id ("default" for
-        # the base model) — the airwatch cost ledger's shed/quota feed;
-        # EVERY tenant appears here, metered or not
+        # per-tenant admission outcomes keyed by billing tenant — the
+        # explicit ``tenant`` label when one rides the request (the batch
+        # lane's ``batch:<job_id>``), else adapter_id, else "default" —
+        # the airwatch cost ledger's shed/quota feed; EVERY tenant appears
+        # here, metered or not
         self.tenants: Dict[str, Dict[str, int]] = {}
 
-    def _tenant_outcome(self, adapter_id: Optional[str],
+    def _tenant_outcome(self, tenant: Optional[str],
                         outcome: str) -> None:
-        """Count one admission outcome against a tenant (``self._lock``
-        must be held)."""
-        key = adapter_id if adapter_id else "default"
+        """Count one admission outcome against a billing tenant
+        (``self._lock`` must be held)."""
+        key = tenant if tenant else "default"
         d = self.tenants.get(key)
         if d is None:
             d = {"admitted": 0, "queued": 0, "shed": 0, "quota_shed": 0}
@@ -258,7 +267,8 @@ class AdmissionController:
         return "admit"
 
     def _check_quota(self, priority: str,
-                     adapter_id: Optional[str]) -> None:
+                     adapter_id: Optional[str],
+                     tenant: Optional[str] = None) -> None:
         """Raise :class:`QuotaExceededError` (and count the 429) when the
         tenant is at its in-flight cap; otherwise take one in-flight unit.
         Quota is checked BEFORE the class decision so a hot tenant cannot
@@ -276,7 +286,7 @@ class AdmissionController:
             held = self._tenant_inflight.get(adapter_id, 0)
             if held >= cap:
                 self.quota_shed[priority] += 1
-                self._tenant_outcome(adapter_id, "quota_shed")
+                self._tenant_outcome(tenant or adapter_id, "quota_shed")
                 raise QuotaExceededError(
                     f"tenant {adapter_id!r} is at its queue share "
                     f"({held}/{cap} in flight)",
@@ -299,27 +309,31 @@ class AdmissionController:
                 self._tenant_inflight[adapter_id] = held - 1
 
     def admit(self, priority: str,
-              adapter_id: Optional[str] = None) -> None:
+              adapter_id: Optional[str] = None,
+              tenant: Optional[str] = None) -> None:
         """Admit-or-raise for one new request: a "queue" decision waits
         proxy-side (re-scraping each poll) up to the class's
-        ``queue_timeout_s``, then sheds.  Raises
-        :class:`QuotaExceededError` when the tenant is over its share
-        (429), :class:`AdmissionShedError` on class shed (503); returns
-        normally on admit — the caller then owes a matching
-        :meth:`release` for metered tenants."""
-        self._check_quota(priority, adapter_id)
+        ``queue_timeout_s``, then sheds.  ``tenant`` is the BILLING label
+        for outcome attribution (falls back to ``adapter_id``) — quota
+        metering stays keyed on ``adapter_id``, the thing shares are
+        declared against.  Raises :class:`QuotaExceededError` when the
+        tenant is over its share (429), :class:`AdmissionShedError` on
+        class shed (503); returns normally on admit — the caller then
+        owes a matching :meth:`release` for metered tenants."""
+        bill = tenant or adapter_id
+        self._check_quota(priority, adapter_id, bill)
         try:
             decision = self.decide(priority)
             if decision == "admit":
                 with self._lock:
                     self.admitted[priority] += 1
-                    self._tenant_outcome(adapter_id, "admitted")
+                    self._tenant_outcome(bill, "admitted")
                 return
             p = self.policy
             if decision == "queue":
                 with self._lock:
                     self.queued[priority] += 1
-                    self._tenant_outcome(adapter_id, "queued")
+                    self._tenant_outcome(bill, "queued")
                 deadline = time.monotonic() + float(
                     p.queue_timeout_s.get(priority, 0.0))
                 while time.monotonic() < deadline:
@@ -328,13 +342,13 @@ class AdmissionController:
                     if decision == "admit":
                         with self._lock:
                             self.admitted[priority] += 1
-                            self._tenant_outcome(adapter_id, "admitted")
+                            self._tenant_outcome(bill, "admitted")
                         return
                     if decision == "shed":
                         break
             with self._lock:
                 self.shed[priority] += 1
-                self._tenant_outcome(adapter_id, "shed")
+                self._tenant_outcome(bill, "shed")
             raise AdmissionShedError(
                 f"{priority}-class shed at the proxy "
                 f"(queue depth/replica past policy thresholds)",

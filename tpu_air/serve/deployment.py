@@ -226,6 +226,7 @@ class DeploymentHandle:
         self._stop = threading.Event()
         self._restarts_left = d.max_restarts  # -1 = unlimited
         self._target = d.num_replicas    # autoscaler-mutable replica target
+        self._spawning = 0               # scale_up spawns in flight (not yet in rotation)
         self._draining: List[Any] = []   # out of rotation, pinned-reachable
         self._inflight: Dict[str, int] = {}  # actor id -> in-flight calls
         self._loads: Dict[str, float] = {}   # actor id -> scraped load
@@ -409,6 +410,10 @@ class DeploymentHandle:
             if self._stop.is_set():
                 return False
             self._target += 1
+            # the restart controller must not read target-minus-live as a
+            # deficit while THIS spawn is still pinging — it would spawn a
+            # phantom second replica nothing ever retires
+            self._spawning += 1
         replica = None
         try:
             replica = _spawn_replica(self._app)
@@ -417,10 +422,12 @@ class DeploymentHandle:
                 if self._stop.is_set():
                     raise NoLiveReplicasError("handle retired during scale-up")
                 self._replicas.append(replica)
+                self._spawning -= 1
             return True
         except Exception:  # noqa: BLE001 — failed scale-up must not leak the spawn
             with self._lock:
                 self._target -= 1
+                self._spawning -= 1
             if replica is not None:
                 from tpu_air.core.remote import kill
 
@@ -429,6 +436,18 @@ class DeploymentHandle:
                 except Exception:  # noqa: BLE001 — best-effort kill; replica may already be dead
                     pass
             return False
+
+    def shrink_target(self) -> int:
+        """Lower the restart controller's replica target by one (floor 1)
+        WITHOUT retiring anyone here — for callers already retiring a
+        specific replica through another path (the batch lane's borrow
+        return rides the preemption watcher's drain; without this the
+        controller would respawn the returned replica right back).
+        Returns the new target."""
+        with self._lock:
+            if self._target > 1:
+                self._target -= 1
+            return self._target
 
     def scale_down(self, timeout: float = 120.0) -> bool:
         """Remove one replica, gracefully: out of rotation FIRST (no new
@@ -520,7 +539,8 @@ class DeploymentHandle:
             live = [r for r in self._replicas if not _actor_dead(r)]
             pruned = len(self._replicas) - len(live)
             self._replicas = live
-            deficit = self._target - len(live)
+            # in-flight scale_up spawns already cover part of the target
+            deficit = self._target - len(live) - self._spawning
         if pruned:
             backoff = 0.25  # fresh death: reset the crash-loop backoff
         if deficit <= 0 or self._restarts_left == 0:

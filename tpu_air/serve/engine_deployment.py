@@ -179,6 +179,7 @@ class _EngineServer:
                 priority=payload.get("priority", "interactive"),
                 deadline_ms=payload.get("deadline_ms"),
                 adapter_id=payload.get("adapter_id"),
+                tenant=payload.get("tenant"),
             )}
         if action == "poll":
             return self.poll(int(payload.get("request_id", -1)),
@@ -213,12 +214,14 @@ class _EngineServer:
     def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
                priority: str = "interactive",
                deadline_ms: Optional[float] = None,
-               adapter_id: Optional[str] = None) -> int:
+               adapter_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> int:
         # deadline_ms is absolute unix-epoch ms (the proxy converts the
         # client's relative budget at admission).  Passed through only when
         # set: the T5 window engine doesn't take it, and None means "no
         # deadline" everywhere.  Same for adapter_id (multi-tenant LoRA —
-        # paged causal-LM engines only).
+        # paged causal-LM engines only) and tenant (pure cost-attribution
+        # label, e.g. the batch lane's ``batch:<job_id>``).
         kw = {} if deadline_ms is None else {"deadline_ms": float(deadline_ms)}
         front = self._front()
         if adapter_id is not None:
@@ -228,6 +231,12 @@ class _EngineServer:
                     "adapter_id is not supported with disaggregated "
                     "serving (prefill workers hold no adapter bank)")
             kw["adapter_id"] = str(adapter_id)
+        if tenant is not None and self._router is None \
+                and hasattr(front, "submit_migrated"):
+            # pure billing label, causal-LM engines only — the T5 window
+            # engine (and the disagg router) take no per-request tenant;
+            # dropping the label there degrades attribution, never submits
+            kw["tenant"] = str(tenant)
         stream = front.submit(prompt, max_new_tokens,
                               priority=priority, **kw)
         self._streams[stream.request_id] = stream
@@ -360,6 +369,21 @@ class _EngineServer:
             "notice_s": self._preempt_notice_s,
             "notice_left_s": max(0.0, left),
         }
+
+    def borrow_return(self, notice_s: float = 5.0) -> bool:
+        """Elastic chip borrowing (tpu_air/batch): hand this replica's
+        chips back to the pool THROUGH the preemption path — deliver a
+        revocation notice to our own lease, which freezes admission and
+        lets the driver-side watcher drain/migrate live slots exactly as
+        a real preemption would.  The batch broker calls this on replicas
+        it borrowed during a trough when interactive load returns; reusing
+        the lease-notice machinery means borrow-return is chaos-tested by
+        construction.  Returns False when there is no lease to revoke
+        (engine never built — nothing to return)."""
+        if self._lease is None:
+            return False
+        self._lease.deliver_notice(float(notice_s))
+        return True
 
     def migrate_out(self) -> list:
         """Freeze this replica's engine and pull every live decoding

@@ -254,8 +254,14 @@ class _Handler(BaseHTTPRequestHandler):
                         adapter_id = payload.get("adapter_id")
                         if adapter_id is not None:
                             adapter_id = str(adapter_id)
-                        # raises QuotaExceededError (429) / shed (503)
-                        controller.admit(priority, adapter_id=adapter_id)
+                        tenant = payload.get("tenant")
+                        if tenant is not None:
+                            tenant = str(tenant)
+                        # raises QuotaExceededError (429) / shed (503);
+                        # ``tenant`` only relabels billing attribution —
+                        # quota metering stays keyed on adapter_id
+                        controller.admit(priority, adapter_id=adapter_id,
+                                         tenant=tenant)
                         if adapter_id is not None:
                             quota_hold = (controller, adapter_id)
                         clamped = controller.policy.clamp_budget(
@@ -317,7 +323,8 @@ class _Handler(BaseHTTPRequestHandler):
                         priority=str(
                             payload.get("priority") or "interactive"),
                         deadline_ms=payload.get("deadline_ms"),
-                        adapter_id=payload.get("adapter_id"))
+                        adapter_id=payload.get("adapter_id"),
+                        tenant=payload.get("tenant"))
                 if (action == "submit" and quota_hold is not None
                         and isinstance(result, dict)
                         and "request_id" in result):
@@ -556,6 +563,26 @@ def shutdown() -> None:
         _state.journal = RequestJournal()
 
 
+def route_control(route_prefix: str) -> Dict[str, Any]:
+    """The driver-side control surface for one deployed route: its
+    deployment handle, admission controller, autoscaler (or None),
+    preemption watcher, and the shared request journal.  The batch lane's
+    :class:`~tpu_air.batch.BatchJobRunner` drives THROUGH these — the same
+    admission path, journal replay, and preemption orchestration online
+    traffic gets, rather than a parallel offline stack."""
+    with _state.lock:
+        handle = _state.routes.get(route_prefix)
+        if handle is None:
+            raise KeyError(f"no deployment at route {route_prefix!r}")
+        return {
+            "handle": handle,
+            "admission": _state.admission.get(route_prefix),
+            "autoscaler": _state.autoscalers.get(route_prefix),
+            "watcher": _state.watchers.get(route_prefix),
+            "journal": _state.journal,
+        }
+
+
 def replica_engine_stats() -> Dict[str, Dict[str, Any]]:
     """Engine-metrics snapshots from every deployed replica, merged across
     routes — the dashboard folds this into ``/api/engines`` + ``/metrics``
@@ -623,6 +650,16 @@ def serve_control_stats() -> Dict[str, Any]:
         weights = {}
     if weights:
         out["weights"] = weights
+    # batch lane (tpu_air/batch): per-job progress/borrowing gauges ride
+    # the same bare-key convention as "recovery"/"weights"
+    try:
+        from tpu_air.batch import jobs_stats as _bjobs
+
+        batch = _bjobs()
+    except Exception:  # noqa: BLE001 — stats must never 500 the proxy
+        batch = {}
+    if batch:
+        out["batch"] = batch
     return out
 
 

@@ -65,6 +65,7 @@ class JournalEntry:
     priority: str
     deadline_ms: Optional[float]  # absolute unix-epoch ms
     adapter_id: Optional[str] = None  # tenant LoRA adapter, None = base
+    tenant: Optional[str] = None  # billing label (batch:<job_id>), not a bank row
     tokens: List[int] = field(default_factory=list)  # delivered prefix
     done: bool = False
     # after a replay: (new replica tag, new request id, token offset) — the
@@ -96,7 +97,8 @@ class RequestJournal:
                       prompt, max_new_tokens: Optional[int],
                       priority: str,
                       deadline_ms: Optional[float],
-                      adapter_id: Optional[str] = None) -> None:
+                      adapter_id: Optional[str] = None,
+                      tenant: Optional[str] = None) -> None:
         entry = JournalEntry(
             prefix=prefix, pin=pin, request_id=int(request_id),
             prompt=[int(t) for t in (prompt or [])],
@@ -104,7 +106,8 @@ class RequestJournal:
                             else int(max_new_tokens)),
             priority=str(priority),
             deadline_ms=(None if deadline_ms is None else float(deadline_ms)),
-            adapter_id=(None if adapter_id is None else str(adapter_id)))
+            adapter_id=(None if adapter_id is None else str(adapter_id)),
+            tenant=(None if tenant is None else str(tenant)))
         with self._lock:
             self._entries[(prefix, pin, int(request_id))] = entry
             while len(self._entries) > self._cap:
@@ -194,6 +197,10 @@ class RequestJournal:
                 # the continuation must decode under the SAME tenant
                 # adapter or the forced-prefix replay changes tokens
                 payload["adapter_id"] = entry.adapter_id
+            if entry.tenant is not None:
+                # billing continuity: the continuation's tokens belong to
+                # the same cost tenant as the stream it resumes
+                payload["tenant"] = entry.tenant
             body = json.dumps(payload).encode()
             deadline = Deadline.at_ms(entry.deadline_ms)
             backoff = Backoff(base=0.05, cap=1.0, seed=0)
@@ -321,8 +328,23 @@ class PreemptionWatcher:
         #: are being re-seated — the bench's ``preemption_recovery_ms``
         self.preemption_recovery_ms = 0.0
         self._handled: set = set()  # replica tags already orchestrated
+        # replica tags whose coming revocation is a BORROW RETURN (the
+        # batch broker handing a soaked replica back, engine_deployment
+        # ``borrow_return``): orchestrated exactly like a real preemption
+        # — drain, migrate, out of rotation — but WITHOUT the autoscaler
+        # backfill, because the capacity is leaving on purpose
+        self._borrowed: set = set()
+        self.borrow_returns = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def mark_borrowed(self, tag: str) -> None:
+        """Flag ``tag``'s next revocation notice as a voluntary borrow
+        return (no autoscaler scale-up).  Called by the batch broker
+        BEFORE it delivers the notice — the watcher thread only reads the
+        flag inside :meth:`_orchestrate`, after the notice lands."""
+        with self._lock:
+            self._borrowed.add(str(tag))
 
     # -- replica RPC plumbing -------------------------------------------------
     @staticmethod
@@ -379,7 +401,11 @@ class PreemptionWatcher:
         t_start = time.monotonic()
         with self._lock:
             self.preemptions += 1
-        if self._autoscaler is not None:
+            borrowed = tag in self._borrowed
+            if borrowed:
+                self._borrowed.discard(tag)
+                self.borrow_returns += 1
+        if self._autoscaler is not None and not borrowed:
             threading.Thread(  # blocking spawn: keep it off the notice clock
                 target=self._notice_autoscaler, daemon=True,
                 name=f"preemption-scale-up-{self._prefix}").start()
@@ -410,7 +436,7 @@ class PreemptionWatcher:
             _watch.current().note(
                 "preemption.recovered", route=self._prefix, replica=tag,
                 recovery_ms=round(recovery_ms, 3),
-                migrated_all=migrated_all)
+                migrated_all=migrated_all, borrowed=borrowed)
         # the serve plane took everything it wants from the zombie
         # (payloads migrated, pollers re-pinned or replaying): terminate
         # it so its chips return to the pool — the preempted capacity must
@@ -483,6 +509,7 @@ class PreemptionWatcher:
         with self._lock:
             return {
                 "preemptions": self.preemptions,
+                "borrow_returns": self.borrow_returns,
                 "migrations": self.migrations,
                 "migrated_pages": self.migrated_pages,
                 "migration_fallbacks": self.migration_fallbacks,
