@@ -1,0 +1,198 @@
+"""Unit tests for the crashflow effect analysis (aircrash).
+
+Two layers:
+
+1. effect-sequence mechanics over small fixtures — extraction order,
+   parameter substitution at inline time, annotation parsing, and the
+   unknown-degrades-to-silence contract;
+2. the commit-order *proofs* over the real tree: the weights-manifest and
+   batch-chunk annotation pairs must show every covered data write
+   ordered before its commit point in the shipped sources, with zero
+   CS003 findings — the machine-checked form of the manifest-written-LAST
+   and chunk-before-checkpoint disciplines.
+
+Pure stdlib, no jax import (tpu_air.analysis never pulls it in).
+"""
+
+import textwrap
+from pathlib import Path
+
+from tpu_air.analysis.context import ModuleContext
+from tpu_air.analysis.dataflow import ProgramContext
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _crashflow(src, path="mod.py"):
+    ctx = ModuleContext(path, textwrap.dedent(src))
+    return ProgramContext([ctx]).crashflow
+
+
+def _kinds(seq):
+    return [e.kind for e in seq]
+
+
+class TestEffectSequences:
+    def test_seal_sequence_extracts_in_source_order(self):
+        cf = _crashflow("""\
+            import json
+            import os
+
+            def seal(state, path):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(state, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            """)
+        seq = cf.sequence("mod.seal")
+        assert _kinds(seq) == ["write", "flush", "fsync", "rename"]
+        assert seq[0].target == "tmp"
+        assert seq[3].src == "tmp" and seq[3].dst == "path"
+
+    def test_param_substitution_lines_up_caller_and_callee(self):
+        cf = _crashflow("""\
+            import os
+
+            def fill(dst, data):
+                with open(dst, "w") as f:
+                    f.write(data)
+
+            def seal(data, path):
+                tmp = path + ".tmp"
+                fill(tmp, data)
+                os.replace(tmp, path)
+            """)
+        seq = cf.sequence("mod.seal")
+        assert _kinds(seq) == ["write", "rename"]
+        # the helper's `dst` was substituted by the caller's `tmp`, so the
+        # write target and the rename source are the same expression
+        assert seq[0].target == seq[1].src == "tmp"
+        assert seq[0].chain[-1] == "mod.fill"
+
+    def test_two_inlined_helpers_do_not_alias_their_locals(self):
+        # both helpers use a local called `tmp`; frame scoping must keep
+        # writer A's tmp from satisfying renamer B's provenance search
+        cf = _crashflow("""\
+            import os
+
+            def writer():
+                with open("a.tmp", "w") as f:
+                    tmp = "x"
+                    f.write(tmp)
+
+            def renamer():
+                tmp = "b.tmp"
+                os.replace(tmp, "b")
+
+            def run():
+                writer()
+                renamer()
+            """)
+        seq = cf.sequence("mod.run")
+        write, rename = seq[0], seq[1]
+        assert write.kind == "write" and rename.kind == "rename"
+        assert rename.src != "tmp"  # scoped, not the bare local name
+        assert rename.src.endswith("::tmp")
+
+    def test_annotations_parse_trailing_and_standalone(self):
+        cf = _crashflow("""\
+            def run(store, chunk):
+                store.put(chunk, object_id="c0")  # aircrash: data epoch
+                # aircrash: commits epoch
+                store.put([0], object_id="ckpt")
+            """)
+        seq = cf.sequence("mod.run")
+        tagged = [(e.kind, e.target) for e in seq
+                  if e.kind in ("data", "commit")]
+        assert tagged == [("data", "epoch"), ("commit", "epoch")]
+
+    def test_unrenderable_paths_degrade_to_silence(self):
+        # f-string path expressions render as unknown; unknown must never
+        # participate in a match, so nothing fires despite the missing fsync
+        cf = _crashflow("""\
+            import os
+
+            def seal(state, path):
+                with open(f"{path}.new", "w") as f:
+                    f.write(state)
+                os.replace(f"{path}.new", path)
+            """)
+        assert cf.run() == []
+
+    def test_string_replace_is_not_a_rename(self):
+        cf = _crashflow("""\
+            def fmt(s):
+                return s.replace("a", "b")
+            """)
+        assert cf.sequence("mod.fmt") == []
+
+    def test_loop_bodies_walk_once(self):
+        # commit-inside-the-loop after the data write is the batch shape;
+        # a naive loop unroll would pair iteration N's commit with
+        # iteration N+1's data write and fabricate an inversion
+        cf = _crashflow("""\
+            def run(store, chunks):
+                for i, chunk in enumerate(chunks):
+                    store.put(chunk, object_id=str(i))  # aircrash: data epoch
+                    # aircrash: commits epoch
+                    store.put([i], object_id="ckpt")
+            """)
+        assert [f.rule for f in cf.run()] == []
+
+    def test_append_mode_open_is_not_a_publish_write(self):
+        cf = _crashflow("""\
+            def log(path, line):
+                with open(path, "a") as f:
+                    f.write(line)
+            """)
+        assert _kinds(cf.sequence("mod.log")) == ["write"]
+
+
+class TestCommitOrderProofs:
+    """CS003 over the real tree: zero findings over annotated code is a
+    proof, and these tests additionally pin the effect order itself so a
+    refactor that silently drops an annotation cannot pass as vacuously
+    clean."""
+
+    def _program(self, *rel):
+        ctxs = [ModuleContext(str(REPO / r), (REPO / r).read_text())
+                for r in rel]
+        return ProgramContext(ctxs)
+
+    def _assert_proof(self, cf, qname, tag):
+        seq = cf.sequence(qname)
+        data = [i for i, e in enumerate(seq)
+                if e.kind == "data" and e.target == tag]
+        commits = [i for i, e in enumerate(seq)
+                   if e.kind == "commit" and e.target == tag]
+        assert data, f"{qname}: no data({tag}) effect — annotation lost?"
+        assert commits, f"{qname}: no commit({tag}) effect — annotation lost?"
+        assert max(data) < min(commits), \
+            f"{qname}: a commit({tag}) precedes a data write it covers"
+
+    def test_weights_manifest_written_last(self):
+        prog = self._program("tpu_air/serve/weights.py")
+        cf = prog.crashflow
+        base = "tpu_air.serve.weights.WeightStore"
+        self._assert_proof(cf, f"{base}.publish", "weights-manifest")
+        self._assert_proof(cf, f"{base}._publish_kind", "weights-manifest")
+        assert not [f for f in cf.run() if f.rule == "CS003"]
+
+    def test_batch_chunk_before_checkpoint(self):
+        prog = self._program("tpu_air/batch/job.py")
+        cf = prog.crashflow
+        self._assert_proof(cf, "tpu_air.batch.job.BatchJob._run_inner",
+                           "batch-chunk")
+        assert not [f for f in cf.run() if f.rule == "CS003"]
+
+    def test_manifest_seal_carries_flush_and_fsync(self):
+        # the CS002 shape of the same discipline: the manifest rename must
+        # see flush+fsync between the write and the seal
+        prog = self._program("tpu_air/serve/weights.py")
+        seq = prog.crashflow.sequence(
+            "tpu_air.serve.weights.WeightStore.publish")
+        kinds = _kinds(seq)
+        w, r = kinds.index("write"), kinds.index("rename")
+        assert "flush" in kinds[w:r] and "fsync" in kinds[w:r]

@@ -28,7 +28,8 @@ def test_analysis_package_is_clean_under_dataflow_rules_alone():
     isolation — both views must agree that the package is clean."""
     reports = analyze_paths([str(REPO / "tpu_air" / "analysis")],
                             only=["CC001", "CC002", "CC003", "JX006",
-                                  "JX007", "JX008", "JX009", "PL001"])
+                                  "JX007", "JX008", "JX009", "PL001",
+                                  "CS001", "CS002", "CS003", "FI001"])
     findings = [f for rep in reports for f in rep.findings]
     assert not findings, "\n".join(
         f"  {f.location()}: {f.rule}: {f.message}" for f in findings)

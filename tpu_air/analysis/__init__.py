@@ -35,6 +35,7 @@ from . import rules_runtime as _rules_runtime  # noqa: E402,F401
 from .context import ModuleContext
 from .dataflow import ProgramContext
 from .dataflow import rules_concurrency as _rules_cc  # noqa: E402,F401
+from .dataflow import rules_crash as _rules_cs  # noqa: E402,F401
 from .dataflow import rules_jitflow as _rules_jf  # noqa: E402,F401
 from .dataflow import rules_shapes as _rules_sh  # noqa: E402,F401
 from .suppressions import apply_suppressions, parse_suppressions

@@ -15,6 +15,7 @@ import json
 import os
 import subprocess
 import sys
+import textwrap
 from typing import List, Optional, Set
 
 from . import analyze_paths, all_rules
@@ -45,7 +46,11 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="diff base for --changed (default: "
                         "`git merge-base HEAD main`)")
     p.add_argument("--rules", default=None, metavar="ID[,ID...]",
-                   help="run only these rule ids")
+                   help="run only these rule ids; a bare family prefix "
+                        "selects the whole family (e.g. --rules CS,FI)")
+    p.add_argument("--explain", default=None, metavar="RULE",
+                   help="print one rule's doc + a minimal fires example "
+                        "and exit")
     p.add_argument("--baseline", default=None, metavar="FILE",
                    help="suppress findings recorded in FILE — only *new* "
                         "findings fail the run (see --baseline-write)")
@@ -63,6 +68,47 @@ def _list_rules() -> None:
     for r in sorted(all_rules(), key=lambda r: r.id):
         print(f"{r.id}  {r.severity:<7}  {r.name}")
         print(f"       {r.rationale}")
+
+
+def _expand_rule_families(tokens: List[str]) -> List[str]:
+    """``--rules CS,FI`` selects every registered rule whose id starts
+    with the token; exact ids (and unknown tokens, which select_rules
+    rejects with rc 2) pass through unchanged."""
+    ids = sorted(r.id for r in all_rules())
+    out = []
+    for tok in tokens:
+        tok = tok.strip()
+        if not tok:
+            continue
+        family = [i for i in ids if i.startswith(tok)]
+        if tok not in ids and family:
+            out.extend(family)
+        else:
+            out.append(tok)
+    return out
+
+
+def _explain(rule_id: str) -> int:
+    from .registry import get_rule, known_rule_ids
+
+    if rule_id not in known_rule_ids():
+        print(f"airlint: unknown rule id {rule_id!r} "
+              "(see --list-rules)", file=sys.stderr)
+        return 2
+    r = get_rule(rule_id)
+    print(f"{r.id} — {r.name} ({r.severity})")
+    print(f"\n{r.rationale}")
+    doc = getattr(r.check, "__doc__", None) if r.check else None
+    if doc:
+        import inspect
+
+        print(f"\n{inspect.cleandoc(doc)}")
+    if r.example:
+        print("\nMinimal example that fires:\n")
+        print(textwrap.indent(textwrap.dedent(r.example).strip(), "    "))
+    else:
+        print("\nExamples: docs/ANALYSIS.md rule catalog.")
+    return 0
 
 
 def _git(args: List[str]) -> Optional[str]:
@@ -220,7 +266,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         _list_rules()
         return 0
-    only = args.rules.split(",") if args.rules else None
+    if args.explain:
+        return _explain(args.explain)
+    only = _expand_rule_families(args.rules.split(",")) if args.rules \
+        else None
     changed = None
     if args.changed:
         changed = changed_files(args.changed_base)

@@ -23,6 +23,7 @@ from ..context import ModuleContext
 from ..findings import Finding
 from ..registry import get_rule
 from .callgraph import CallGraph, module_name  # noqa: F401 — re-export
+from .crashflow import CrashFlowAnalysis
 from .jitflow import JitFlowAnalysis
 from .lockset import LocksetAnalysis, RawFinding
 from .shapes import ShapeAnalysis
@@ -32,6 +33,8 @@ _ANALYSIS_FOR_RULE = {
     "JX006": "jitflow",
     "JX007": "shapes", "JX008": "shapes", "JX009": "shapes",
     "PL001": "shapes",
+    "CS001": "crashflow", "CS002": "crashflow", "CS003": "crashflow",
+    "FI001": "crashflow",
 }
 
 
@@ -47,6 +50,7 @@ class ProgramContext:
         self._lockset: Optional[LocksetAnalysis] = None
         self._jitflow: Optional[JitFlowAnalysis] = None
         self._shapes: Optional[ShapeAnalysis] = None
+        self._crashflow: Optional[CrashFlowAnalysis] = None
 
     @property
     def callgraph(self) -> CallGraph:
@@ -74,6 +78,13 @@ class ProgramContext:
             self._shapes = ShapeAnalysis(self.callgraph)
             self._shapes.run()
         return self._shapes
+
+    @property
+    def crashflow(self) -> CrashFlowAnalysis:
+        if self._crashflow is None:
+            self._crashflow = CrashFlowAnalysis(self.callgraph)
+            self._crashflow.run()
+        return self._crashflow
 
     def module(self, path: str) -> Optional[ModuleContext]:
         return self._by_path.get(os.path.normpath(path))

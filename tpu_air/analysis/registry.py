@@ -9,7 +9,7 @@ directly — the CLI discovers everything through :func:`all_rules`.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Dict, Iterable, List
+from typing import Callable, Dict, Iterable, List, Optional
 
 from .findings import Finding, Severity
 
@@ -21,6 +21,7 @@ class Rule:
     severity: str
     rationale: str
     check: Callable
+    example: Optional[str] = None  # minimal fires example (--explain)
 
 
 _REGISTRY: Dict[str, Rule] = {}
@@ -37,13 +38,14 @@ META_RULES = {
 }
 
 
-def rule(id: str, name: str, severity: str, rationale: str):
+def rule(id: str, name: str, severity: str, rationale: str,
+         example: Optional[str] = None):
     """Decorator: register ``check(ctx) -> Iterable[Finding]`` under ``id``."""
 
     def deco(fn):
         if id in _REGISTRY or id in META_RULES:
             raise ValueError(f"duplicate airlint rule id {id!r}")
-        _REGISTRY[id] = Rule(id, name, severity, rationale, fn)
+        _REGISTRY[id] = Rule(id, name, severity, rationale, fn, example)
         return fn
 
     return deco

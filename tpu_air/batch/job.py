@@ -305,6 +305,7 @@ class BatchJob:
                                 "job_id": self.job_id, "shard": s,
                                 "chunk": chunk, "rows": n}):
                             outputs = self._process_chunk(items, ctl)
+                        # aircrash: data batch-chunk
                         store.put({"job_id": self.job_id, "shard": s,
                                    "chunk": chunk, "rows": outputs},
                                   object_id=cid)
@@ -372,6 +373,7 @@ class BatchJob:
 
     def _write_checkpoint(self, store, counts,
                           cursors: List[ShardCursor]) -> None:
+        # aircrash: commits batch-chunk
         store.put({
             "job_id": self.job_id,
             "seq": self._next_ckpt_seq,

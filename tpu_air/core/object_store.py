@@ -122,6 +122,9 @@ class ObjectStore:
         try:
             with open(tmp, "w") as f:
                 f.write(os.path.abspath(self.root))
+                f.flush()
+                os.fsync(f.fileno())  # airlint CS002: a torn marker reads
+                # as a bogus root path and the sweeper reaps a live session
             os.rename(tmp, marker)
         except OSError:
             pass
@@ -154,6 +157,11 @@ class ObjectStore:
             import shutil
 
             shutil.copyfile(src, tmp)
+            with open(tmp, "rb") as f:
+                os.fsync(f.fileno())  # airlint CS002: copyfile leaves the
+                # bytes in page cache; sealing before they are durable can
+                # survive a power loss that the data does not — and the
+                # source is unlinked right after
             os.chmod(tmp, 0o444)
             os.rename(tmp, dst)  # atomic seal in the spill dir
             os.chmod(src, 0o644)
@@ -206,6 +214,12 @@ class ObjectStore:
     # -- write ------------------------------------------------------------
     def put(self, value: Any, object_id: Optional[str] = None) -> ObjectRef:
         object_id = object_id or new_object_id()
+        if _faults.enabled():
+            # the write-side twin of the get hook: every producer (weights
+            # publish, batch chunks, journal snapshots) funnels through
+            # here, so one site gives the chaos lane a handle on all of
+            # them — found by airlint FI001's funnel-coverage audit
+            _faults.perturb("object_store.put", key=object_id)
         self.put_serialized(serialization.serialize(value), object_id)
         return ObjectRef(object_id)
 
@@ -226,6 +240,10 @@ class ObjectStore:
         with open(tmp, "wb") as f:
             for c in chunks:
                 f.write(c)
+            f.flush()
+            os.fsync(f.fileno())  # airlint CS002: the rename seal claims
+            # crash atomicity for sealed objects — that claim is only true
+            # once the bytes are durable, not just in page cache
         os.chmod(tmp, 0o444)  # immutability contract
         os.rename(tmp, os.path.join(target_root, object_id))
 

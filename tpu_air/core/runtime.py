@@ -775,7 +775,7 @@ class Runtime:
                     trace_id = self.task_trace.pop(task_id, None)
                     if trace_id:
                         crashed_traces.append(trace_id)
-                    self.store.put(
+                    self.store.put(  # airlint: disable=CC003 — chaos-only: the fault-plan delay inside put models the slow-disk stall this bounded error-sentinel write already risks under the lock; zero cost with no plan installed
                         _ErrorSentinel(
                             f"WorkerCrashed(worker={worker.worker_id})",
                             "worker process died while executing this task",
@@ -1372,7 +1372,7 @@ class Runtime:
                 return
             st = self.actors.get(spec.actor_id)
             if st is None or st.dead or not st.worker.alive:
-                self.store.put(
+                self.store.put(  # airlint: disable=CC003 — chaos-only: the fault-plan delay inside put models the slow-disk stall this bounded error-sentinel write already risks under the lock; zero cost with no plan installed
                     _ErrorSentinel(
                         f"ActorDiedError(actor={spec.actor_id})", "",
                         trace_id=(spec.trace_ctx or {}).get("trace_id"),
@@ -1407,7 +1407,7 @@ class Runtime:
                 st.pending -= 1
                 self.task_resources.pop(spec.task_id, None)
                 self.task_worker.pop(spec.task_id, None)
-                self.store.put(
+                self.store.put(  # airlint: disable=CC003 — chaos-only: the fault-plan delay inside put models the slow-disk stall this bounded error-sentinel write already risks under the lock; zero cost with no plan installed
                     _ErrorSentinel(
                         f"ActorDiedError(actor={spec.actor_id})",
                         "worker pipe broken at submit",
@@ -1451,7 +1451,7 @@ class Runtime:
                 self.actor_queue = [r for r in self.actor_queue if r["actor_id"] != actor_id]
                 buffered = self.pending_actor_tasks.pop(actor_id, [])
                 for tid in [rec["ready_id"]] + [s.task_id for s in buffered]:
-                    self.store.put(
+                    self.store.put(  # airlint: disable=CC003 — chaos-only: the fault-plan delay inside put models the slow-disk stall this bounded error-sentinel write already risks under the lock; zero cost with no plan installed
                         _ErrorSentinel(f"ActorDiedError(actor={actor_id})", ""), tid
                     )
                 self._notify_objects()

@@ -25,6 +25,8 @@ Sites and the actions they honor (the hook decides what "kill" means):
 site                  actions
 ====================  ==========================================
 ``object_store.get``  ``delay`` (slow fetch), ``drop`` (TimeoutError)
+``object_store.put``  ``delay`` (slow publish), ``drop`` (TimeoutError),
+                      ``error`` (the write fails before any byte lands)
 ``actor.call``        ``delay``, ``kill`` (crash the target actor)
 ``runtime.task``      ``delay``
 ``runtime.lease``     ``revoke`` (LeaseRevokedError after claim),

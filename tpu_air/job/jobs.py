@@ -65,6 +65,10 @@ def _write_state(job_id: str, state: Dict[str, Any]) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(state, f, indent=2, default=str)
+        f.flush()
+        os.fsync(f.fileno())  # found by airlint CS002: the replace below is
+        # atomic, but without fsync a power loss could keep the rename and
+        # lose the bytes — `air job status` would read a torn job.json
     os.replace(tmp, path)
 
 
@@ -108,7 +112,7 @@ def submit(spec_or_path, wait_for_completion: bool = False) -> str:
     }
     _write_state(job_id, state)
 
-    log_f = open(log_path, "wb")
+    log_f = open(log_path, "wb")  # airlint: disable=CS001 — driver.log is an append-only stream tailed by `air job logs`; readers tolerate a torn tail and there is no atomic-publish contract to seal
     env = _resolve_env(spec)
     env["TPU_AIR_JOB_ID"] = job_id
     proc = subprocess.Popen(

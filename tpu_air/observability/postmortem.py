@@ -98,6 +98,11 @@ def dump(reason: str, context: Optional[Dict[str, Any]] = None,
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f, indent=2, default=str)
+            f.flush()
+            os.fsync(f.fileno())  # airlint CS002: a postmortem that can be
+            # torn by the same power loss that made it worth writing is
+            # useless — fsync before the seal (still inside the outer
+            # try, so the never-raises guarantee holds)
         os.replace(tmp, path)
         return path
     except Exception:  # noqa: BLE001 — the flight recorder must never crash its host

@@ -224,7 +224,7 @@ class WeightStore:
             # evict a torn predecessor's orphan shard first: objects are
             # immutable, so putting over a live id would keep its bytes
             self._store.delete(oid)
-            self._store.put(arr, oid)
+            self._store.put(arr, oid)  # aircrash: data weights-manifest
         manifest = {
             "version": version,
             "kind": "full",
@@ -273,7 +273,7 @@ class WeightStore:
                 "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()),
             })
             self._store.delete(oid)  # same orphan-shard eviction as publish()
-            self._store.put(arr, oid)
+            self._store.put(arr, oid)  # aircrash: data weights-manifest
         manifest = {
             "version": version,
             "kind": kind,
@@ -294,7 +294,9 @@ class WeightStore:
             json.dump(manifest, f, sort_keys=True)
             f.flush()
             os.fsync(f.fileno())
-        os.rename(tmp, path)
+        # aircrash: commits weights-manifest
+        os.rename(tmp, path)  # manifest-written-LAST: airlint CS003 proves
+        # every shard put precedes this rename in all publish flows
 
     # -- restore -------------------------------------------------------------
     def load(self, version: Optional[int] = None) -> Dict[str, Any]:
